@@ -1,0 +1,26 @@
+"""p2pfl_tpu — a TPU-native decentralized federated learning framework.
+
+A brand-new framework with the capabilities of Fedstellar/p2pfl
+(reference: /root/reference — DFL/CFL/SDFL federations, gossip weight
+exchange over arbitrary topologies, node roles, FedAvg and robust
+aggregation, scenario orchestration and observability), re-designed
+TPU-first on JAX/XLA:
+
+- A federation is a **sharded SPMD program on a device mesh**: federated
+  node *i* lives on mesh position *i* along a ``nodes`` axis; local
+  training is a jit-compiled ``lax.scan``; weight exchange is a masked
+  XLA collective (``all_gather``/``ppermute``/``psum_scatter``) over ICI
+  instead of pickled tensors over TCP sockets
+  (reference: fedstellar/communication_protocol.py, gossiper.py).
+- The asynchronous control plane of the reference (membership,
+  heartbeats, role transfer, timeouts — fedstellar/heartbeater.py,
+  node.py) becomes an explicit, deterministic round state machine on the
+  host, with failure injection as first-class simulation state.
+- Aggregation (reference: fedstellar/learning/aggregators/) is a pure
+  function over a stacked parameter pytree with boolean
+  contributor/alive masks — fixed shapes, jit-able, MXU-friendly.
+"""
+
+from p2pfl_tpu.version import __version__
+
+__all__ = ["__version__"]

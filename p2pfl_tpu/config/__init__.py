@@ -1,0 +1,19 @@
+from p2pfl_tpu.config.schema import (
+    DataConfig,
+    FaultEvent,
+    ModelConfig,
+    NodeConfig,
+    ProtocolConfig,
+    ScenarioConfig,
+    TrainingConfig,
+)
+
+__all__ = [
+    "DataConfig",
+    "FaultEvent",
+    "ModelConfig",
+    "NodeConfig",
+    "ProtocolConfig",
+    "ScenarioConfig",
+    "TrainingConfig",
+]

@@ -1,0 +1,187 @@
+"""Scenario / participant configuration.
+
+Dataclass-validated successor of the reference's JSON flag system
+(fedstellar/config/participant.json.example — sections scenario_args /
+device_args / network_args / data_args / model_args / training_args /
+aggregator_args / tracking_args — and fedstellar/config/config.py).
+
+One ``ScenarioConfig`` describes the whole federation (the reference
+stamps N per-participant JSONs from one designer form,
+controller.py:247-298; here per-node differences are the ``nodes``
+list). JSON round-trips for tooling parity.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import pathlib
+from typing import Any
+
+FEDERATIONS = ("DFL", "CFL", "SDFL")  # node.py:649, app/main.py:13-14
+ROLES = ("trainer", "aggregator", "server", "proxy", "idle")  # fedstellar/role.py
+
+
+@dataclasses.dataclass
+class DataConfig:
+    """data_args + partitioning knobs (mnist.py:56-118)."""
+
+    dataset: str = "mnist"
+    partition: str = "iid"  # iid | sorted (label-sorted non-IID) | dirichlet
+    dirichlet_alpha: float = 0.5
+    samples_per_node: int | None = None  # cap shard size; None = full split
+    batch_size: int = 32  # mnist.py:56
+    val_percent: float = 0.1  # mnist.py:59
+    seed: int = 0
+
+
+@dataclasses.dataclass
+class ModelConfig:
+    """model_args (node_start.py:46-85 model factory)."""
+
+    model: str = "mlp"
+    param_dtype: str = "float32"
+    compute_dtype: str = "bfloat16"  # MXU-native
+    kwargs: dict[str, Any] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class TrainingConfig:
+    """training_args (participant.json.example:47)."""
+
+    rounds: int = 3
+    epochs_per_round: int = 3
+    optimizer: str = "sgd"
+    learning_rate: float = 0.1
+    momentum: float = 0.9
+    weight_decay: float = 0.0
+    eval_every: int = 1  # rounds between federated evaluations
+
+
+@dataclasses.dataclass
+class ProtocolConfig:
+    """Successor of the wire-protocol tunables
+    (participant.json.example:68-83). Most reference constants existed
+    to pace threads over TCP (gossip Hz, heartbeat period); on a mesh
+    the dataplane is synchronous, so only the semantically meaningful
+    ones survive, and they act on the async/DCN control plane.
+    """
+
+    aggregation_timeout_s: float = 60.0  # AGGREGATION_TIMEOUT
+    heartbeat_period_s: float = 4.0  # HEARTBEAT_PERIOD
+    node_timeout_s: float = 20.0  # NODE_TIMEOUT
+    gossip_models_per_round: int = 2  # GOSSIP_MODELS_PER_ROUND
+    gossip_exit_on_equal_rounds: int = 20  # GOSSIP_EXIT_ON_X_EQUAL_ROUNDS
+    train_set_size: int = 10  # TRAIN_SET_SIZE
+
+
+@dataclasses.dataclass
+class FaultEvent:
+    """Deterministic fault injection: node ``node`` dies at round
+    ``round`` (and optionally recovers). The reference can only inject
+    network degradation via tcset (base_node.py:82-85); crash-testing
+    there means killing processes by hand. Here it is scenario state.
+    """
+
+    node: int = 0
+    round: int = 0
+    kind: str = "crash"  # crash | recover
+
+
+@dataclasses.dataclass
+class NodeConfig:
+    """Per-node overrides (device_args in the reference)."""
+
+    idx: int = 0
+    role: str = "trainer"
+    start: bool = False  # which node initiates learning (device_args.start)
+
+    def __post_init__(self):
+        if self.role not in ROLES:
+            raise ValueError(f"unknown role {self.role!r}; have {ROLES}")
+
+
+@dataclasses.dataclass
+class ScenarioConfig:
+    """A whole federation scenario."""
+
+    name: str = "scenario"
+    federation: str = "DFL"
+    topology: str = "fully"
+    topology_kwargs: dict[str, Any] = dataclasses.field(default_factory=dict)
+    n_nodes: int = 2
+    data: DataConfig = dataclasses.field(default_factory=DataConfig)
+    model: ModelConfig = dataclasses.field(default_factory=ModelConfig)
+    training: TrainingConfig = dataclasses.field(default_factory=TrainingConfig)
+    protocol: ProtocolConfig = dataclasses.field(default_factory=ProtocolConfig)
+    aggregator: str = "fedavg"
+    aggregator_kwargs: dict[str, Any] = dataclasses.field(default_factory=dict)
+    nodes: list[NodeConfig] = dataclasses.field(default_factory=list)
+    faults: list[FaultEvent] = dataclasses.field(default_factory=list)
+    seed: int = 0
+    checkpoint_dir: str | None = None
+    checkpoint_every: int = 0  # rounds; 0 = off
+    log_dir: str | None = None
+
+    def __post_init__(self):
+        if self.federation not in FEDERATIONS:
+            raise ValueError(
+                f"unknown federation {self.federation!r}; have {FEDERATIONS}"
+            )
+        if self.n_nodes < 1:
+            raise ValueError("n_nodes must be >= 1")
+        if not self.nodes:
+            self.nodes = self._default_nodes()
+        if len(self.nodes) != self.n_nodes:
+            raise ValueError(
+                f"{len(self.nodes)} node configs for n_nodes={self.n_nodes}"
+            )
+
+    def _default_nodes(self) -> list[NodeConfig]:
+        """Role assignment by federation scheme (controller.py:247-298 +
+        role semantics node.py:427-524): DFL = every node trains and
+        aggregates; CFL = node 0 is the server, rest are trainers; SDFL
+        = node 0 starts as the rotating aggregator."""
+        nodes = []
+        for i in range(self.n_nodes):
+            if self.federation == "CFL":
+                role = "server" if i == 0 else "trainer"
+            elif self.federation == "SDFL":
+                role = "aggregator" if i == 0 else "trainer"
+            else:
+                role = "aggregator"  # DFL: trainer+aggregator combined
+            nodes.append(NodeConfig(idx=i, role=role, start=(i == 0)))
+        return nodes
+
+    # ---- JSON round-trip -------------------------------------------------
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self), indent=2, sort_keys=True)
+
+    def save(self, path: str | pathlib.Path) -> None:
+        pathlib.Path(path).write_text(self.to_json())
+
+    @staticmethod
+    def from_dict(d: dict) -> "ScenarioConfig":
+        d = dict(d)
+        for field, cls in [
+            ("data", DataConfig),
+            ("model", ModelConfig),
+            ("training", TrainingConfig),
+            ("protocol", ProtocolConfig),
+        ]:
+            if field in d and isinstance(d[field], dict):
+                d[field] = cls(**d[field])
+        if "nodes" in d:
+            d["nodes"] = [
+                NodeConfig(**n) if isinstance(n, dict) else n for n in d["nodes"]
+            ]
+        if "faults" in d:
+            d["faults"] = [
+                FaultEvent(**f) if isinstance(f, dict) else f for f in d["faults"]
+            ]
+        return ScenarioConfig(**d)
+
+    @staticmethod
+    def load(path: str | pathlib.Path) -> "ScenarioConfig":
+        return ScenarioConfig.from_dict(json.loads(pathlib.Path(path).read_text()))

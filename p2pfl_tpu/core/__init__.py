@@ -1,0 +1,62 @@
+"""Core dataplane: pytree parameter math, safe serialization, aggregation.
+
+Pure-JAX, no I/O. This layer is the TPU-native replacement for the
+reference's torch ``state_dict`` arithmetic
+(fedstellar/learning/aggregators/fedavg.py:26-60) and its
+pickle-over-TCP serialization
+(fedstellar/learning/pytorch/lightninglearner.py:73-89).
+"""
+
+from p2pfl_tpu.core.pytree import (
+    tree_add,
+    tree_cast,
+    tree_global_norm,
+    tree_param_count,
+    tree_scale,
+    tree_stack,
+    tree_sub,
+    tree_unstack,
+    tree_weighted_mean,
+    tree_zeros_like,
+)
+from p2pfl_tpu.core.serialize import (
+    DecodingParamsError,
+    ModelNotMatchingError,
+    ParamsPayload,
+    check_parameters,
+    decode_parameters,
+    encode_parameters,
+)
+from p2pfl_tpu.core.aggregators import (
+    Aggregator,
+    FedAvg,
+    FedMedian,
+    Krum,
+    TrimmedMean,
+    get_aggregator,
+)
+
+__all__ = [
+    "tree_add",
+    "tree_cast",
+    "tree_global_norm",
+    "tree_param_count",
+    "tree_scale",
+    "tree_stack",
+    "tree_sub",
+    "tree_unstack",
+    "tree_weighted_mean",
+    "tree_zeros_like",
+    "DecodingParamsError",
+    "ModelNotMatchingError",
+    "ParamsPayload",
+    "check_parameters",
+    "decode_parameters",
+    "encode_parameters",
+    "Aggregator",
+    "FedAvg",
+    "FedMedian",
+    "Krum",
+    "TrimmedMean",
+    "get_aggregator",
+]

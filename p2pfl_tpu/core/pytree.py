@@ -1,0 +1,90 @@
+"""Parameter-pytree arithmetic.
+
+Models are pytrees of ``jnp.ndarray`` (flax param dicts). A *federation*
+of N nodes is the same pytree with a leading ``nodes`` axis on every
+leaf ("stacked" form) — that leading axis is what gets sharded over the
+TPU mesh or vmapped on a single chip.
+
+Replaces the reference's per-layer ``state_dict`` loops
+(fedstellar/learning/aggregators/fedavg.py:46-58) with
+``jax.tree.map`` so XLA sees one fused program.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+Params = Any  # a pytree of jnp.ndarray
+
+
+def tree_zeros_like(tree: Params) -> Params:
+    return jax.tree.map(jnp.zeros_like, tree)
+
+
+def tree_add(a: Params, b: Params) -> Params:
+    return jax.tree.map(jnp.add, a, b)
+
+
+def tree_sub(a: Params, b: Params) -> Params:
+    return jax.tree.map(jnp.subtract, a, b)
+
+
+def tree_scale(tree: Params, s) -> Params:
+    return jax.tree.map(lambda x: x * s, tree)
+
+
+def tree_cast(tree: Params, dtype) -> Params:
+    return jax.tree.map(lambda x: x.astype(dtype), tree)
+
+
+def tree_param_count(tree: Params) -> int:
+    return sum(int(x.size) for x in jax.tree.leaves(tree))
+
+
+def tree_global_norm(tree: Params) -> jnp.ndarray:
+    leaves = [jnp.sum(jnp.square(x.astype(jnp.float32))) for x in jax.tree.leaves(tree)]
+    return jnp.sqrt(jnp.sum(jnp.stack(leaves)))
+
+
+def tree_stack(trees: list[Params]) -> Params:
+    """Stack N same-structure pytrees into one with a leading node axis."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs, axis=0), *trees)
+
+
+def tree_unstack(stacked: Params, n: int | None = None) -> list[Params]:
+    """Inverse of :func:`tree_stack`."""
+    if n is None:
+        n = jax.tree.leaves(stacked)[0].shape[0]
+    return [jax.tree.map(lambda x: x[i], stacked) for i in range(n)]
+
+
+def tree_weighted_mean(stacked: Params, weights: jnp.ndarray) -> Params:
+    """Weighted mean over the leading node axis.
+
+    ``weights`` has shape ``[n]``; zero-weight entries drop out, so an
+    alive/contributor mask can be folded into the weights. Semantics of
+    the reference's FedAvg (fedstellar/learning/aggregators/fedavg.py:
+    46-58: accumulate ``m[layer]*w`` then divide by total samples), with
+    the accumulation done in float32 regardless of storage dtype.
+
+    Degenerate case: if the total weight is zero (nothing arrived before
+    the aggregation timeout and the caller masked everything out), the
+    result falls back to the **uniform mean over all rows** rather than
+    silently zeroing the model. Federation callers always include self
+    in the mask, so this fallback only fires on direct misuse.
+    """
+    total = jnp.sum(weights)
+    n = jnp.shape(weights)[0]
+    weights = jnp.where(total > 0, weights, jnp.ones_like(weights))
+    total = jnp.where(total > 0, total, jnp.asarray(n, total.dtype))
+    w = (weights / total).astype(jnp.float32)
+
+    def leaf_mean(x):
+        wshape = (x.shape[0],) + (1,) * (x.ndim - 1)
+        acc = jnp.sum(x.astype(jnp.float32) * w.reshape(wshape), axis=0)
+        return acc.astype(x.dtype)
+
+    return jax.tree.map(leaf_mean, stacked)

@@ -1,0 +1,109 @@
+"""Safe wire serialization for model parameters.
+
+Replaces the reference's ``pickle.dumps(([ndarray, ...], contributors,
+weight))`` payloads (fedstellar/learning/pytorch/lightninglearner.py:
+73-89) — pickle is code-execution-unsafe between federated peers — with
+a versioned msgpack envelope built on ``flax.serialization``. Decode
+never executes code; shape/dtype validation against a template pytree
+mirrors the reference's ``check_parameters``
+(lightninglearner.py:91-99) and its ``ModelNotMatchingError``
+(fedstellar/learning/exceptions.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import struct
+import zlib
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import serialization as flax_ser
+
+_MAGIC = b"P2TP"  # p2pfl_tpu params
+_VERSION = 1
+_HEADER = struct.Struct(">4sHII")  # magic, version, contributor-count, crc32
+
+
+class DecodingParamsError(Exception):
+    """Raised when a payload cannot be decoded (reference: learning/exceptions.py)."""
+
+
+class ModelNotMatchingError(Exception):
+    """Raised when decoded params don't match the local model template."""
+
+
+@dataclasses.dataclass
+class ParamsPayload:
+    """What moves between federated nodes.
+
+    ``contributors`` is the set of node indices whose local models are
+    folded into ``params`` (the reference tracks these as string sets,
+    fedstellar/learning/aggregators/aggregator.py:151; here they are
+    int indices so they can become fixed-shape boolean masks on device).
+    ``weight`` is the total sample count backing the payload.
+    """
+
+    params: Any
+    contributors: tuple[int, ...] = ()
+    weight: int = 1
+
+
+def encode_parameters(params: Any, contributors: tuple[int, ...] = (), weight: int = 1) -> bytes:
+    """Encode a params pytree + metadata into a self-describing payload."""
+    host_params = jax.tree.map(np.asarray, params)
+    body = flax_ser.msgpack_serialize({"p": host_params, "w": np.int64(weight)})
+    contrib = struct.pack(f">{len(contributors)}I", *contributors)
+    crc = zlib.crc32(contrib + body)
+    header = _HEADER.pack(_MAGIC, _VERSION, len(contributors), crc)
+    return header + contrib + body
+
+
+def decode_parameters(blob: bytes) -> ParamsPayload:
+    """Decode a payload. Raises DecodingParamsError on any malformation."""
+    try:
+        magic, version, n_contrib, crc = _HEADER.unpack_from(blob, 0)
+        if magic != _MAGIC or version != _VERSION:
+            raise ValueError(f"bad magic/version {magic!r}/{version}")
+        if zlib.crc32(blob[_HEADER.size :]) != crc:
+            raise ValueError("payload CRC mismatch (corrupt or tampered)")
+        off = _HEADER.size
+        contributors = struct.unpack_from(f">{n_contrib}I", blob, off)
+        off += 4 * n_contrib
+        obj = flax_ser.msgpack_restore(blob[off:])
+        return ParamsPayload(
+            params=obj["p"], contributors=tuple(contributors), weight=int(obj["w"])
+        )
+    except DecodingParamsError:
+        raise
+    except Exception as e:  # malformed struct/msgpack — never execute code
+        raise DecodingParamsError(f"could not decode params payload: {e}") from e
+
+
+def check_parameters(params: Any, template: Any) -> None:
+    """Validate structure + leaf shapes/dtypes against a template pytree.
+
+    Mirrors lightninglearner.py:91-99 (zip state_dict keys, compare
+    shapes) but also catches structure mismatches.
+    """
+    t_struct = jax.tree.structure(template)
+    p_struct = jax.tree.structure(params)
+    if t_struct != p_struct:
+        raise ModelNotMatchingError(
+            f"pytree structure mismatch: got {p_struct}, want {t_struct}"
+        )
+    for got, want in zip(jax.tree.leaves(params), jax.tree.leaves(template)):
+        got_shape = jnp.shape(got)
+        want_shape = jnp.shape(want)
+        if got_shape != want_shape:
+            raise ModelNotMatchingError(
+                f"leaf shape mismatch: got {got_shape}, want {want_shape}"
+            )
+        got_dtype = jnp.result_type(got)
+        want_dtype = jnp.result_type(want)
+        if got_dtype != want_dtype:
+            raise ModelNotMatchingError(
+                f"leaf dtype mismatch: got {got_dtype}, want {want_dtype}"
+            )

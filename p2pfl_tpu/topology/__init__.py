@@ -1,0 +1,19 @@
+from p2pfl_tpu.topology.topology import (
+    Topology,
+    fully_connected,
+    generate_topology,
+    metropolis_weights,
+    random_topology,
+    ring,
+    star,
+)
+
+__all__ = [
+    "Topology",
+    "fully_connected",
+    "generate_topology",
+    "metropolis_weights",
+    "random_topology",
+    "ring",
+    "star",
+]

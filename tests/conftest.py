@@ -1,0 +1,30 @@
+"""Test harness: force an 8-device virtual CPU mesh.
+
+Multi-chip TPU hardware is not available in CI; the sharded federation
+paths are validated on 8 virtual CPU devices (the driver separately
+dry-run-compiles the multi-chip path via __graft_entry__.dryrun_multichip).
+
+This must run before JAX initializes a backend, hence the top-level
+os.environ mutation in conftest (pytest imports conftest first).
+"""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def n_devices():
+    n = len(jax.devices())
+    assert n == 8, f"expected 8 virtual CPU devices, got {n}"
+    return n

@@ -1,0 +1,83 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from p2pfl_tpu.core import FedAvg, FedMedian, Krum, TrimmedMean, get_aggregator, tree_stack
+
+
+def const_params(val, shape=(4, 3)):
+    return {"w": jnp.full(shape, float(val)), "b": jnp.full((shape[1],), float(val))}
+
+
+def stacked_consts(vals):
+    return tree_stack([const_params(v) for v in vals])
+
+
+def test_fedavg_weighted():
+    st = stacked_consts([0.0, 1.0, 2.0])
+    out = FedAvg()(st, jnp.array([1.0, 1.0, 2.0]))
+    np.testing.assert_allclose(out["w"], np.full((4, 3), (0 + 1 + 4) / 4.0), rtol=1e-6)
+
+
+def test_fedavg_mask_equals_partial_trainset():
+    # timeout-with-partial-arrivals semantics: masked rows contribute nothing
+    st = stacked_consts([0.0, 100.0, 2.0])
+    out = FedAvg()(st, jnp.ones(3), mask=jnp.array([True, False, True]))
+    np.testing.assert_allclose(out["w"], np.ones((4, 3)), rtol=1e-6)
+
+
+def test_median_resists_outlier():
+    st = stacked_consts([1.0, 1.0, 1.0, 1.0, 1000.0])
+    out = FedMedian()(st, jnp.ones(5))
+    np.testing.assert_allclose(out["w"], np.ones((4, 3)))
+
+
+def test_trimmed_mean_drops_extremes():
+    st = stacked_consts([-1000.0, 1.0, 2.0, 3.0, 1000.0])
+    out = TrimmedMean(beta=1)(st, jnp.ones(5))
+    np.testing.assert_allclose(out["w"], np.full((4, 3), 2.0), rtol=1e-6)
+
+
+def test_krum_picks_cluster_not_byzantine():
+    # 4 honest models near 1.0, one byzantine at 50 — krum must pick a
+    # model from the honest cluster
+    st = stacked_consts([1.0, 1.1, 0.9, 1.05, 50.0])
+    out = Krum(f=1, m=1)(st, jnp.ones(5))
+    assert float(out["w"][0, 0]) < 2.0
+
+
+def test_krum_masked_row_never_selected():
+    st = stacked_consts([5.0, 5.0, 0.0, 5.0, 5.0])
+    # row 2 would win (closest to nothing since others are identical) — mask it out
+    out = Krum(f=0, m=1)(st, jnp.ones(5), mask=jnp.array([True, True, False, True, True]))
+    np.testing.assert_allclose(out["w"], np.full((4, 3), 5.0))
+
+
+def test_aggregators_jit_compile():
+    st = stacked_consts([1.0, 2.0, 3.0, 4.0, 5.0])
+    w = jnp.ones(5)
+    m = jnp.array([True] * 5)
+    for agg in [FedAvg(), FedMedian(), TrimmedMean(1), Krum(1, 2)]:
+        f = jax.jit(lambda s, w, m, a=agg: a(s, w, m))
+        out = f(st, w, m)
+        assert jax.tree.structure(out) == jax.tree.structure(const_params(0.0))
+
+
+def test_registry():
+    assert isinstance(get_aggregator("FedAvg"), FedAvg)
+    assert isinstance(get_aggregator("trimmed-mean", beta=2), TrimmedMean)
+    assert isinstance(get_aggregator("krum", f=2), Krum)
+    with pytest.raises(ValueError):
+        get_aggregator("nope")
+
+
+def test_all_masked_falls_back_to_uniform_mean_not_zeros():
+    st = stacked_consts([1.0, 3.0])
+    out = FedAvg()(st, jnp.ones(2), mask=jnp.array([False, False]))
+    np.testing.assert_allclose(out["w"], np.full((4, 3), 2.0))
+
+
+def test_trimmed_mean_rejects_negative_beta():
+    with pytest.raises(ValueError):
+        TrimmedMean(beta=-1)

@@ -1,0 +1,48 @@
+import pytest
+
+from p2pfl_tpu.config import FaultEvent, NodeConfig, ScenarioConfig
+
+
+def test_defaults_dfl():
+    c = ScenarioConfig(n_nodes=4)
+    assert c.federation == "DFL"
+    assert all(n.role == "aggregator" for n in c.nodes)
+    assert c.nodes[0].start and not c.nodes[1].start
+
+
+def test_cfl_roles():
+    c = ScenarioConfig(federation="CFL", topology="star", n_nodes=5)
+    assert c.nodes[0].role == "server"
+    assert all(n.role == "trainer" for n in c.nodes[1:])
+
+
+def test_sdfl_roles():
+    c = ScenarioConfig(federation="SDFL", n_nodes=3)
+    assert c.nodes[0].role == "aggregator"
+    assert c.nodes[1].role == "trainer"
+
+
+def test_validation():
+    with pytest.raises(ValueError):
+        ScenarioConfig(federation="XFL")
+    with pytest.raises(ValueError):
+        NodeConfig(role="king")
+    with pytest.raises(ValueError):
+        ScenarioConfig(n_nodes=3, nodes=[NodeConfig(idx=0)])
+
+
+def test_json_roundtrip(tmp_path):
+    c = ScenarioConfig(
+        name="exp1",
+        federation="SDFL",
+        topology="ring",
+        topology_kwargs={"convergence_edges": 2},
+        n_nodes=8,
+        aggregator="krum",
+        aggregator_kwargs={"f": 1},
+        faults=[FaultEvent(node=3, round=2)],
+    )
+    p = tmp_path / "scenario.json"
+    c.save(p)
+    c2 = ScenarioConfig.load(p)
+    assert c2 == c

@@ -1,0 +1,60 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from p2pfl_tpu.core import (
+    tree_global_norm,
+    tree_param_count,
+    tree_stack,
+    tree_unstack,
+    tree_weighted_mean,
+)
+
+
+def make_params(seed):
+    k = jax.random.PRNGKey(seed)
+    k1, k2 = jax.random.split(k)
+    return {
+        "dense": {"kernel": jax.random.normal(k1, (4, 3)), "bias": jnp.zeros((3,))},
+        "out": {"kernel": jax.random.normal(k2, (3, 2))},
+    }
+
+
+def test_stack_unstack_roundtrip():
+    trees = [make_params(i) for i in range(5)]
+    stacked = tree_stack(trees)
+    assert jax.tree.leaves(stacked)[0].shape[0] == 5
+    back = tree_unstack(stacked)
+    for a, b in zip(trees, back):
+        for la, lb in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+            np.testing.assert_allclose(la, lb)
+
+
+def test_param_count_and_norm():
+    p = make_params(0)
+    assert tree_param_count(p) == 4 * 3 + 3 + 3 * 2
+    n = tree_global_norm(p)
+    manual = np.sqrt(sum(np.sum(np.square(np.asarray(x))) for x in jax.tree.leaves(p)))
+    np.testing.assert_allclose(n, manual, rtol=1e-6)
+
+
+def test_weighted_mean_matches_manual():
+    trees = [make_params(i) for i in range(3)]
+    stacked = tree_stack(trees)
+    w = jnp.array([1.0, 2.0, 3.0])
+    out = tree_weighted_mean(stacked, w)
+    for leaf_out, *leaves in zip(
+        jax.tree.leaves(out), *(jax.tree.leaves(t) for t in trees)
+    ):
+        manual = (leaves[0] * 1 + leaves[1] * 2 + leaves[2] * 3) / 6.0
+        np.testing.assert_allclose(leaf_out, manual, rtol=1e-5)
+
+
+def test_weighted_mean_zero_weight_drops_row():
+    trees = [make_params(i) for i in range(3)]
+    stacked = tree_stack(trees)
+    out = tree_weighted_mean(stacked, jnp.array([1.0, 0.0, 1.0]))
+    for leaf_out, l0, l2 in zip(
+        jax.tree.leaves(out), jax.tree.leaves(trees[0]), jax.tree.leaves(trees[2])
+    ):
+        np.testing.assert_allclose(leaf_out, (l0 + l2) / 2.0, rtol=1e-5)

@@ -1,0 +1,70 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from p2pfl_tpu.core import (
+    DecodingParamsError,
+    ModelNotMatchingError,
+    check_parameters,
+    decode_parameters,
+    encode_parameters,
+)
+
+
+def params():
+    return {
+        "dense": {"kernel": jnp.arange(12.0).reshape(4, 3), "bias": jnp.ones((3,))},
+    }
+
+
+def test_roundtrip_with_metadata():
+    blob = encode_parameters(params(), contributors=(0, 3, 7), weight=1234)
+    out = decode_parameters(blob)
+    assert out.contributors == (0, 3, 7)
+    assert out.weight == 1234
+    np.testing.assert_allclose(out.params["dense"]["kernel"], params()["dense"]["kernel"])
+
+
+def test_no_pickle_garbage_rejected():
+    import pickle
+
+    evil = pickle.dumps(([np.zeros(3)], None, 1))
+    with pytest.raises(DecodingParamsError):
+        decode_parameters(evil)
+    with pytest.raises(DecodingParamsError):
+        decode_parameters(b"short")
+    # right magic, corrupt body
+    blob = encode_parameters(params())
+    with pytest.raises(DecodingParamsError):
+        decode_parameters(blob[:-10])
+
+
+def test_check_parameters():
+    check_parameters(params(), params())
+    bad_shape = {"dense": {"kernel": jnp.zeros((4, 4)), "bias": jnp.ones((3,))}}
+    with pytest.raises(ModelNotMatchingError):
+        check_parameters(bad_shape, params())
+    bad_struct = {"dense": {"kernel": jnp.zeros((4, 3))}}
+    with pytest.raises(ModelNotMatchingError):
+        check_parameters(bad_struct, params())
+
+
+def test_decoded_params_feed_jax():
+    blob = encode_parameters(params(), weight=5)
+    out = decode_parameters(blob)
+    total = jax.tree.reduce(lambda a, x: a + jnp.sum(x), out.params, 0.0)
+    assert float(total) == float(np.arange(12.0).sum() + 3)
+
+
+def test_check_parameters_dtype_mismatch():
+    bad_dtype = {"dense": {"kernel": jnp.zeros((4, 3), jnp.int8), "bias": jnp.ones((3,))}}
+    with pytest.raises(ModelNotMatchingError):
+        check_parameters(bad_dtype, params())
+
+
+def test_bit_flip_rejected_by_crc():
+    blob = bytearray(encode_parameters(params(), contributors=(1,), weight=7))
+    blob[14] ^= 0xFF
+    with pytest.raises(DecodingParamsError):
+        decode_parameters(bytes(blob))

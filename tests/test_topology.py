@@ -1,0 +1,85 @@
+import numpy as np
+import pytest
+
+from p2pfl_tpu.topology import (
+    Topology,
+    fully_connected,
+    generate_topology,
+    random_topology,
+    ring,
+    star,
+)
+
+
+def test_fully_connected():
+    t = fully_connected(5)
+    assert t.n == 5
+    assert not t.adjacency.diagonal().any()
+    assert t.degree().tolist() == [4] * 5
+    assert t.is_symmetric() and t.is_connected()
+
+
+def test_ring_and_convergence_edges():
+    t = ring(8)
+    assert t.degree().tolist() == [2] * 8
+    assert t.neighbors(0) == [1, 7]
+    t2 = ring(8, convergence_edges=3, seed=1)
+    assert t2.adjacency.sum() == 8 * 2 + 3 * 2
+    assert t2.is_symmetric()
+
+
+def test_star():
+    t = star(6)
+    assert t.neighbors(0) == [1, 2, 3, 4, 5]
+    for i in range(1, 6):
+        assert t.neighbors(i) == [0]
+
+
+def test_random_connected_and_symmetric():
+    t = random_topology(10, prob=0.3, seed=42)
+    assert t.is_connected() and t.is_symmetric()
+    t2 = random_topology(10, prob=0.5, symmetric=False, seed=42)
+    assert t2.is_connected()
+
+
+def test_mixing_matrix_metropolis_doubly_stochastic():
+    for t in [fully_connected(6), ring(6), random_topology(6, 0.5, seed=3)]:
+        w = t.mixing_matrix("metropolis")
+        np.testing.assert_allclose(w.sum(axis=1), 1.0, atol=1e-12)
+        np.testing.assert_allclose(w.sum(axis=0), 1.0, atol=1e-12)
+        assert (w >= 0).all()
+
+
+def test_mixing_matrix_uniform_row_stochastic():
+    t = star(5)
+    w = t.mixing_matrix("uniform")
+    np.testing.assert_allclose(w.sum(axis=1), 1.0, atol=1e-12)
+    # hub averages everyone; leaves average self+hub
+    assert w[0, 0] == pytest.approx(1 / 5)
+    assert w[1, 1] == pytest.approx(1 / 2)
+
+
+def test_dict_roundtrip():
+    t = ring(7, convergence_edges=2, seed=9)
+    t2 = Topology.from_dict(t.to_dict())
+    np.testing.assert_array_equal(t.adjacency, t2.adjacency)
+
+
+def test_factory():
+    assert generate_topology("fully", 4).kind == "fully"
+    assert generate_topology("ring", 4).kind == "ring"
+    assert generate_topology("star", 4).kind == "star"
+    with pytest.raises(ValueError):
+        generate_topology("mesh3d", 4)
+
+
+def test_ring_rejects_impossible_convergence_edges():
+    with pytest.raises(ValueError):
+        ring(3, convergence_edges=5)
+
+
+def test_directed_random_is_strongly_connected():
+    for seed in range(6):
+        t = random_topology(5, prob=0.25, symmetric=False, seed=seed)
+        assert (t.adjacency.sum(axis=0) > 0).all(), "node with zero in-degree"
+        assert (t.adjacency.sum(axis=1) > 0).all(), "node with zero out-degree"

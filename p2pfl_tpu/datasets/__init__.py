@@ -1,0 +1,36 @@
+"""Data pipeline: dataset sources, federated partitioning, batching.
+
+Successor of the reference's LightningDataModules
+(fedstellar/learning/pytorch/{mnist,femnist,cifar10,syscall,wadi}/):
+same dataset families, same partitioning semantics (contiguous IID
+shards mnist.py:100-118; label-sorted non-IID mnist.py:76-83), plus
+Dirichlet non-IID (BASELINE.json config 3).
+
+Torch/torchvision-free. Real data is read from ``$P2PFL_TPU_DATA_DIR``
+(npz or MNIST idx-ubyte) when present; otherwise each dataset has a
+deterministic, *learnable* synthetic surrogate with identical shapes
+and class counts, so development, CI, and benchmarks run in a
+zero-egress environment (the reference instead downloads at first use,
+e.g. femnist.py:24-77).
+"""
+
+from p2pfl_tpu.datasets.partition import (
+    dirichlet_partition,
+    iid_partition,
+    partition_indices,
+    sorted_partition,
+)
+from p2pfl_tpu.datasets.sources import DATASETS, DatasetSplits, get_dataset
+from p2pfl_tpu.datasets.data import FederatedDataset, NodeData
+
+__all__ = [
+    "dirichlet_partition",
+    "iid_partition",
+    "partition_indices",
+    "sorted_partition",
+    "DATASETS",
+    "DatasetSplits",
+    "get_dataset",
+    "FederatedDataset",
+    "NodeData",
+]

@@ -1,0 +1,118 @@
+"""Federated dataset views: per-node shards and SPMD-stacked arrays.
+
+The reference gives each node a ``LightningDataModule`` holding its
+shard (mnist.py:100-118) and a DataLoader; here the whole federation's
+data is materialized as **stacked arrays with a leading node axis** —
+``x: [n_nodes, S, ...]`` — padded to a common shard size S with a
+boolean sample mask. That leading axis is exactly what gets sharded
+over the TPU mesh (or vmapped single-chip), so "every node trains an
+epoch" is one XLA program instead of N DataLoader processes.
+
+Per-node train/val split mirrors ``val_percent``
+(mnist.py:56-59: batch 32, 10% val).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from p2pfl_tpu.config.schema import DataConfig
+from p2pfl_tpu.datasets.partition import partition_indices
+from p2pfl_tpu.datasets.sources import DatasetSplits, get_dataset
+
+
+@dataclasses.dataclass
+class NodeData:
+    """One node's shard — the per-node view the learner consumes."""
+
+    x: np.ndarray
+    y: np.ndarray
+    x_val: np.ndarray
+    y_val: np.ndarray
+
+    @property
+    def n_samples(self) -> int:  # FedAvg weight (lightninglearner get_num_samples)
+        return len(self.x)
+
+
+@dataclasses.dataclass
+class FederatedDataset:
+    """All shards of a federation, ragged (per-node) and stacked (SPMD)."""
+
+    name: str
+    num_classes: int
+    input_shape: tuple[int, ...]
+    nodes: list[NodeData]
+    x_test: np.ndarray
+    y_test: np.ndarray
+    synthetic: bool = False
+
+    @property
+    def n_nodes(self) -> int:
+        return len(self.nodes)
+
+    def stacked(self, pad_to: int | None = None):
+        """Pad each node's train shard to a common size and stack.
+
+        Returns ``(x, y, mask, n_samples)`` with shapes
+        ``[n, S, ...], [n, S], [n, S], [n]``. Padding rows are masked
+        out of loss/metrics and, being weight-0, out of FedAvg.
+        """
+        sizes = [nd.n_samples for nd in self.nodes]
+        s = pad_to or max(sizes)
+        if s < max(sizes):
+            raise ValueError(f"pad_to={s} < largest shard {max(sizes)}")
+        n = self.n_nodes
+        x = np.zeros((n, s) + self.input_shape, np.float32)
+        y = np.zeros((n, s), np.int32)
+        mask = np.zeros((n, s), bool)
+        for i, nd in enumerate(self.nodes):
+            k = nd.n_samples
+            x[i, :k] = nd.x
+            y[i, :k] = nd.y
+            mask[i, :k] = True
+        return x, y, mask, np.asarray(sizes, np.int32)
+
+    @staticmethod
+    def make(
+        config: DataConfig,
+        n_nodes: int,
+        splits: DatasetSplits | None = None,
+    ) -> "FederatedDataset":
+        """Build federated shards per the DataConfig partition scheme."""
+        if splits is None:
+            splits = get_dataset(config.dataset, seed=config.seed)
+        parts = partition_indices(
+            splits.y_train, n_nodes, scheme=config.partition,
+            seed=config.seed, alpha=config.dirichlet_alpha,
+        )
+        nodes = []
+        for node_i, idx in enumerate(parts):
+            # shuffle before capping/splitting — sorted/dirichlet
+            # partitions return label-ordered indices, and an unshuffled
+            # head slice would be single-label
+            rng = np.random.default_rng(config.seed * 100003 + node_i)
+            idx = rng.permutation(idx)
+            if config.samples_per_node is not None:
+                idx = idx[: config.samples_per_node]
+            n_val = int(len(idx) * config.val_percent)
+            val_idx, train_idx = idx[:n_val], idx[n_val:]
+            nodes.append(
+                NodeData(
+                    x=splits.x_train[train_idx],
+                    y=splits.y_train[train_idx],
+                    x_val=splits.x_train[val_idx],
+                    y_val=splits.y_train[val_idx],
+                )
+            )
+        return FederatedDataset(
+            name=splits.name,
+            num_classes=splits.num_classes,
+            input_shape=splits.input_shape,
+            nodes=nodes,
+            x_test=splits.x_test,
+            y_test=splits.y_test,
+            synthetic=splits.synthetic,
+        )

@@ -1,0 +1,74 @@
+"""Federated partitioning of a dataset across N nodes.
+
+Reference semantics reproduced:
+- ``iid``: contiguous equal ranges after a seeded shuffle
+  (mnist.py:100-118 — ``rows_by_sub = floor(len/number_sub)``,
+  node i takes rows [i*k, (i+1)*k)).
+- ``sorted``: label-sort the dataset *then* contiguous ranges, giving
+  each node a few labels only (mnist.py:76-83 non-IID flag).
+- ``dirichlet``: per-class Dirichlet(α) allocation across nodes — the
+  standard non-IID benchmark knob (BASELINE.json: "non-IID Dirichlet
+  shards"), absent in the reference.
+
+All return ``list[np.ndarray]`` of row indices, length N.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def iid_partition(labels: np.ndarray, n_nodes: int, seed: int = 0) -> list[np.ndarray]:
+    n = len(labels)
+    rng = np.random.default_rng(seed)
+    order = rng.permutation(n)
+    per = n // n_nodes
+    return [order[i * per : (i + 1) * per] for i in range(n_nodes)]
+
+
+def sorted_partition(labels: np.ndarray, n_nodes: int, seed: int = 0) -> list[np.ndarray]:
+    order = np.argsort(labels, kind="stable")
+    per = len(labels) // n_nodes
+    return [order[i * per : (i + 1) * per] for i in range(n_nodes)]
+
+
+def dirichlet_partition(
+    labels: np.ndarray, n_nodes: int, alpha: float = 0.5, seed: int = 0,
+    min_per_node: int = 2,
+) -> list[np.ndarray]:
+    """Per-class proportions ~ Dirichlet(α); α→∞ is IID, α→0 is 1-class
+    nodes. Redraws until every node has ``min_per_node`` samples."""
+    rng = np.random.default_rng(seed)
+    classes = np.unique(labels)
+    for _ in range(100):
+        shards: list[list[np.ndarray]] = [[] for _ in range(n_nodes)]
+        for c in classes:
+            idx = np.flatnonzero(labels == c)
+            rng.shuffle(idx)
+            props = rng.dirichlet([alpha] * n_nodes)
+            cuts = (np.cumsum(props) * len(idx)).astype(int)[:-1]
+            for node, part in enumerate(np.split(idx, cuts)):
+                shards[node].append(part)
+        parts = [np.concatenate(s) if s else np.empty(0, np.int64) for s in shards]
+        if min(len(p) for p in parts) >= min_per_node:
+            for p in parts:
+                rng.shuffle(p)
+            return parts
+    raise RuntimeError(
+        f"dirichlet_partition could not give every node >= {min_per_node} "
+        f"samples (n={len(labels)}, nodes={n_nodes}, alpha={alpha})"
+    )
+
+
+def partition_indices(
+    labels: np.ndarray, n_nodes: int, scheme: str = "iid", seed: int = 0,
+    alpha: float = 0.5,
+) -> list[np.ndarray]:
+    """Factory by scheme name (DataConfig.partition)."""
+    if scheme == "iid":
+        return iid_partition(labels, n_nodes, seed)
+    if scheme in ("sorted", "non-iid", "noniid"):
+        return sorted_partition(labels, n_nodes, seed)
+    if scheme == "dirichlet":
+        return dirichlet_partition(labels, n_nodes, alpha=alpha, seed=seed)
+    raise ValueError(f"unknown partition scheme {scheme!r}")

@@ -1,0 +1,192 @@
+"""Dataset sources: real files when available, synthetic surrogates otherwise.
+
+Dataset families mirror the reference (SURVEY.md §2.4):
+
+| name     | shape          | classes | reference                          |
+|----------|----------------|---------|------------------------------------|
+| mnist    | 28×28×1        | 10      | mnist/mnist.py                     |
+| femnist  | 28×28×1        | 62      | femnist/femnist.py (LEAF)          |
+| cifar10  | 32×32×3        | 10      | cifar10/cifar10.py                 |
+| syscall  | 17 features    | 9       | syscall/syscall.py                 |
+| wadi     | 123 features   | 2       | wadi/wadi.py                       |
+
+Real data: ``$P2PFL_TPU_DATA_DIR/<name>.npz`` with arrays
+``x_train, y_train, x_test, y_test`` (images HWC float or uint8), or
+for MNIST the standard idx-ubyte files. The reference downloads at
+first use (femnist.py:24-77, syscall.py:60-113); this environment has
+no egress, so absent files fall back to a **deterministic learnable
+surrogate**: each class is a smooth random prototype field plus
+per-sample elastic noise — linearly separable enough that real models
+show real learning curves, hard enough that accuracy is not trivially
+100%.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import gzip
+import os
+import pathlib
+import struct
+import zlib
+
+import numpy as np
+
+_SPECS: dict[str, tuple[tuple[int, ...], int]] = {
+    "mnist": ((28, 28, 1), 10),
+    "femnist": ((28, 28, 1), 62),
+    "cifar10": ((32, 32, 3), 10),
+    "syscall": ((17,), 9),
+    "wadi": ((123,), 2),
+}
+
+DATASETS = tuple(sorted(_SPECS))
+
+
+@dataclasses.dataclass
+class DatasetSplits:
+    """Host-side numpy train/test splits, normalized, channels-last."""
+
+    name: str
+    x_train: np.ndarray
+    y_train: np.ndarray
+    x_test: np.ndarray
+    y_test: np.ndarray
+    num_classes: int
+    synthetic: bool = False
+
+    @property
+    def input_shape(self) -> tuple[int, ...]:
+        return tuple(self.x_train.shape[1:])
+
+
+def _data_dir() -> pathlib.Path | None:
+    d = os.environ.get("P2PFL_TPU_DATA_DIR")
+    return pathlib.Path(d) if d else None
+
+
+def _read_idx(path: pathlib.Path) -> np.ndarray:
+    opener = gzip.open if path.suffix == ".gz" else open
+    with opener(path, "rb") as f:
+        magic = struct.unpack(">I", f.read(4))[0]
+        ndim = magic & 0xFF
+        shape = struct.unpack(f">{ndim}I", f.read(4 * ndim))
+        return np.frombuffer(f.read(), dtype=np.uint8).reshape(shape)
+
+
+def _try_load_real(name: str) -> DatasetSplits | None:
+    d = _data_dir()
+    if d is None:
+        return None
+    npz = d / f"{name}.npz"
+    if npz.exists():
+        z = np.load(npz)
+        return _normalize(
+            name, z["x_train"], z["y_train"], z["x_test"], z["y_test"]
+        )
+    if name == "mnist":  # standard idx-ubyte layout
+        files = {}
+        for key, stems in {
+            "x_train": ["train-images-idx3-ubyte"],
+            "y_train": ["train-labels-idx1-ubyte"],
+            "x_test": ["t10k-images-idx3-ubyte"],
+            "y_test": ["t10k-labels-idx1-ubyte"],
+        }.items():
+            found = None
+            for stem in stems:
+                for cand in (d / "mnist" / stem, d / "mnist" / f"{stem}.gz",
+                             d / stem, d / f"{stem}.gz"):
+                    if cand.exists():
+                        found = cand
+                        break
+                if found:
+                    break
+            if not found:
+                return None
+            files[key] = _read_idx(found)
+        return _normalize(name, files["x_train"], files["y_train"],
+                          files["x_test"], files["y_test"])
+    return None
+
+
+def _normalize(name, x_train, y_train, x_test, y_test) -> DatasetSplits:
+    shape, num_classes = _SPECS[name]
+
+    def prep(x):
+        x = np.asarray(x)
+        if x.dtype == np.uint8:
+            x = x.astype(np.float32) / 255.0
+        x = x.astype(np.float32)
+        if len(shape) == 3 and x.ndim == 3:  # HW → HWC
+            x = x[..., None]
+        return x.reshape((x.shape[0],) + shape)
+
+    return DatasetSplits(
+        name=name,
+        x_train=prep(x_train),
+        y_train=np.asarray(y_train).astype(np.int32).reshape(-1),
+        x_test=prep(x_test),
+        y_test=np.asarray(y_test).astype(np.int32).reshape(-1),
+        num_classes=num_classes,
+    )
+
+
+def _synthetic(name: str, n_train: int, n_test: int, seed: int) -> DatasetSplits:
+    """Class-prototype surrogate: y → smooth prototype P_y; x = P_y
+    rolled by a per-sample shift + gaussian noise. Learnable by linear
+    models yet non-trivial (shift invariance must be learned)."""
+    shape, num_classes = _SPECS[name]
+    rng = np.random.default_rng(seed + zlib.crc32(name.encode()) % (2**16))
+    dim = int(np.prod(shape))
+    protos = rng.normal(0.0, 1.0, size=(num_classes, dim)).astype(np.float32)
+    if len(shape) == 3:  # smooth image prototypes: blur flat noise a little
+        img = protos.reshape((num_classes,) + shape)
+        for ax in (1, 2):
+            img = (
+                0.5 * img
+                + 0.25 * np.roll(img, 1, axis=ax)
+                + 0.25 * np.roll(img, -1, axis=ax)
+            )
+        protos = img.reshape(num_classes, dim)
+
+    def draw(n, rng):
+        y = rng.integers(0, num_classes, size=n).astype(np.int32)
+        shift = rng.integers(0, 4, size=n)
+        base = protos[y]
+        rows = np.arange(dim)
+        x = np.empty((n, dim), np.float32)
+        for s in range(4):
+            m = shift == s
+            if m.any():
+                x[m] = base[m][:, (rows - s) % dim]
+        x += rng.normal(0.0, 0.8, size=x.shape).astype(np.float32)
+        return x.reshape((n,) + shape), y
+
+    x_train, y_train = draw(n_train, rng)
+    x_test, y_test = draw(n_test, rng)
+    return DatasetSplits(
+        name=name, x_train=x_train, y_train=y_train, x_test=x_test,
+        y_test=y_test, num_classes=num_classes, synthetic=True,
+    )
+
+
+_SYNTH_SIZES = {  # match real dataset scale where it matters, smaller for speed
+    "mnist": (20000, 4000),
+    "femnist": (24000, 4000),
+    "cifar10": (20000, 4000),
+    "syscall": (10000, 2000),
+    "wadi": (10000, 2000),
+}
+
+
+def get_dataset(name: str, seed: int = 0,
+                synthetic_sizes: tuple[int, int] | None = None) -> DatasetSplits:
+    """Load a dataset by name — real if files exist, surrogate otherwise."""
+    key = name.lower()
+    if key not in _SPECS:
+        raise ValueError(f"unknown dataset {name!r}; have {DATASETS}")
+    real = _try_load_real(key)
+    if real is not None:
+        return real
+    n_train, n_test = synthetic_sizes or _SYNTH_SIZES[key]
+    return _synthetic(key, n_train, n_test, seed)

@@ -1,0 +1,30 @@
+"""Learning layer: the NodeLearner contract and its JAX implementation.
+
+Successor of the reference's L2 (fedstellar/learning/learner.py — the
+16-method template every learner satisfies — and
+lightninglearner.py, its PyTorch-Lightning instance). Here the learner
+is JAX end-to-end: local training is one jit-compiled
+``lax.scan`` over batches per epoch, metrics are computed on device,
+and parameters are flax pytrees, so a *stack* of learners (one per
+federated node) is the same program under ``vmap``/``shard_map``.
+"""
+
+from p2pfl_tpu.learning.objectives import (
+    cross_entropy_loss,
+    masked_accuracy,
+    mse_loss,
+    ocsvm_loss,
+    get_objective,
+)
+from p2pfl_tpu.learning.learner import JaxLearner, NodeLearner, TrainState
+
+__all__ = [
+    "cross_entropy_loss",
+    "masked_accuracy",
+    "mse_loss",
+    "ocsvm_loss",
+    "get_objective",
+    "JaxLearner",
+    "NodeLearner",
+    "TrainState",
+]

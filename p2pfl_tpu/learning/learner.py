@@ -1,0 +1,357 @@
+"""NodeLearner contract + JaxLearner.
+
+``NodeLearner`` reproduces the reference's template interface
+(fedstellar/learning/learner.py:24-177: set_model/set_data/
+encode_parameters/decode_parameters/check_parameters/set_parameters/
+get_parameters/set_epochs/fit/interrupt_fit/evaluate/get_num_samples/
+init/close/finalize_round/create_trainer) so the federation layer is
+decoupled from the ML stack exactly as in the reference.
+
+``JaxLearner`` is the TPU instance (the reference's is
+lightninglearner.py on PyTorch Lightning). Everything hot is built as
+**pure jittable functions** (`make_step_fns`) over an explicit
+``TrainState`` pytree; the class is a thin host-side shell. That split
+is what lets the federation run N learners as one vmapped/shard_mapped
+XLA program instead of N Lightning Trainers in N processes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import struct
+
+from p2pfl_tpu.core.serialize import (
+    check_parameters,
+    decode_parameters,
+    encode_parameters,
+)
+from p2pfl_tpu.learning.objectives import (
+    NO_ACCURACY_OBJECTIVES,
+    get_objective,
+    masked_accuracy,
+    ocsvm_penalty,
+)
+
+
+class TrainState(struct.PyTreeNode):
+    """Carry for one node's training: params + opt state + rng + step."""
+
+    params: Any
+    opt_state: Any
+    rng: jax.Array
+    step: jnp.int32
+
+
+def make_optimizer(name: str = "sgd", learning_rate: float = 0.1,
+                   momentum: float = 0.9, weight_decay: float = 0.0):
+    """Optimizer factory (TrainingConfig.optimizer)."""
+    name = name.lower()
+    if name == "sgd":
+        tx = optax.sgd(learning_rate, momentum=momentum)
+    elif name == "adam":
+        tx = optax.adam(learning_rate)
+    elif name == "adamw":
+        tx = optax.adamw(learning_rate, weight_decay=weight_decay)
+        return tx
+    else:
+        raise ValueError(f"unknown optimizer {name!r}")
+    if weight_decay:
+        tx = optax.chain(optax.add_decayed_weights(weight_decay), tx)
+    return tx
+
+
+@dataclasses.dataclass(frozen=True)
+class StepFns:
+    """The pure-function core of a learner — safe to vmap/shard_map."""
+
+    init: Callable  # (rng, sample_x) -> TrainState
+    train_epochs: Callable  # (state, x, y, mask, epochs) -> (state, metrics)
+    evaluate: Callable  # (params, x, y, mask) -> metrics dict
+    tx: Any
+
+
+def make_step_fns(
+    model,
+    objective: str = "classification",
+    optimizer: str = "sgd",
+    learning_rate: float = 0.1,
+    momentum: float = 0.9,
+    weight_decay: float = 0.0,
+    batch_size: int = 32,
+    eval_batch_size: int = 512,
+) -> StepFns:
+    """Build jit-able init / train / eval for a flax model.
+
+    Training an epoch is one ``lax.scan`` over batches: a fresh
+    permutation of the shard each epoch, fixed batch count (drop
+    remainder — the reference's DataLoader default), masked loss so
+    padded rows are inert. Epochs themselves are an outer ``lax.scan``,
+    so "fit(E epochs)" is a single XLA program — the moral opposite of
+    the reference building a fresh Lightning Trainer per round
+    (lightninglearner.py:167-193).
+    """
+    loss_fn = get_objective(objective)
+    tx = make_optimizer(optimizer, learning_rate, momentum, weight_decay)
+
+    def init(rng, sample_x) -> TrainState:
+        params = model.init(rng, sample_x)
+        return TrainState(
+            params=params,
+            opt_state=tx.init(params),
+            rng=jax.random.fold_in(rng, 1),
+            step=jnp.int32(0),
+        )
+
+    def batch_loss(params, bx, by, bmask):
+        out = model.apply(params, bx)
+        if objective == "autoencoder":
+            return loss_fn(out, bx, bmask)
+        if objective == "ocsvm":
+            return loss_fn(out, by, bmask) + ocsvm_penalty(params)
+        return loss_fn(out, by, bmask)
+
+    def train_one_epoch(state: TrainState, xym):
+        x, y, mask = xym
+        s = x.shape[0]
+        bsz = min(batch_size, s)  # shards smaller than a batch still train
+        steps = s // bsz
+        used = steps * bsz
+        rng, perm_rng = jax.random.split(state.rng)
+        perm = jax.random.permutation(perm_rng, s)[:used]
+        bx = x[perm].reshape((steps, bsz) + x.shape[1:])
+        by = y[perm].reshape(steps, bsz)
+        bm = mask[perm].reshape(steps, bsz)
+
+        def step(carry, batch):
+            st, loss_sum = carry
+            xb, yb, mb = batch
+            loss, grads = jax.value_and_grad(batch_loss)(st.params, xb, yb, mb)
+            updates, opt_state = tx.update(grads, st.opt_state, st.params)
+            params = optax.apply_updates(st.params, updates)
+            st = st.replace(params=params, opt_state=opt_state,
+                            step=st.step + 1)
+            return (st, loss_sum + loss), None
+
+        (state, loss_sum), _ = jax.lax.scan(step, (state, 0.0), (bx, by, bm))
+        state = state.replace(rng=rng)
+        return state, loss_sum / steps
+
+    def train_epochs(state: TrainState, x, y, mask, epochs: int):
+        def body(st, _):
+            st, loss = train_one_epoch(st, (x, y, mask))
+            return st, loss
+
+        state, losses = jax.lax.scan(body, state, None, length=epochs)
+        return state, {"loss": losses[-1], "loss_per_epoch": losses}
+
+    def evaluate(params, x, y, mask):
+        """Batched eval via scan (bounds device memory on big test sets)."""
+        s = x.shape[0]
+        bsz = min(eval_batch_size, s)
+        steps = (s + bsz - 1) // bsz
+        pad = steps * bsz - s
+        xp = jnp.concatenate([x, jnp.zeros((pad,) + x.shape[1:], x.dtype)])
+        yp = jnp.concatenate([y, jnp.zeros((pad,), y.dtype)])
+        mp = jnp.concatenate([mask, jnp.zeros((pad,), mask.dtype)])
+        bx = xp.reshape((steps, bsz) + x.shape[1:])
+        by = yp.reshape(steps, bsz)
+        bm = mp.reshape(steps, bsz)
+
+        def step(carry, batch):
+            loss_sum, correct_sum, count = carry
+            xb, yb, mb = batch
+            out = model.apply(params, xb)
+            w = mb.astype(jnp.float32)
+            cnt = jnp.sum(w)
+            if objective == "autoencoder":
+                loss = loss_fn(out, xb, mb)
+            elif objective == "ocsvm":
+                loss = loss_fn(out, yb, mb) + ocsvm_penalty(params)
+            else:
+                loss = loss_fn(out, yb, mb)
+            if objective in NO_ACCURACY_OBJECTIVES:
+                acc = jnp.float32(0.0)  # outputs aren't class logits
+            else:
+                acc = masked_accuracy(out, yb, mb)
+            return (loss_sum + loss * cnt, correct_sum + acc * cnt,
+                    count + cnt), None
+
+        (loss_sum, correct_sum, count), _ = jax.lax.scan(
+            step, (jnp.float32(0), jnp.float32(0), jnp.float32(0)), (bx, by, bm)
+        )
+        count = jnp.maximum(count, 1.0)
+        return {"loss": loss_sum / count, "accuracy": correct_sum / count}
+
+    return StepFns(init=init, train_epochs=train_epochs, evaluate=evaluate, tx=tx)
+
+
+class NodeLearner:
+    """The learner template (learner.py:24-177 parity). Methods raise
+    until a concrete learner implements them."""
+
+    def set_model(self, model) -> None: raise NotImplementedError
+    def set_data(self, data) -> None: raise NotImplementedError
+    def encode_parameters(self, params=None, contributors=None, weight=1) -> bytes:
+        raise NotImplementedError
+    def decode_parameters(self, data: bytes): raise NotImplementedError
+    def check_parameters(self, params) -> bool: raise NotImplementedError
+    def set_parameters(self, params) -> None: raise NotImplementedError
+    def get_parameters(self): raise NotImplementedError
+    def set_epochs(self, epochs: int) -> None: raise NotImplementedError
+    def create_trainer(self) -> None: raise NotImplementedError
+    def fit(self) -> None: raise NotImplementedError
+    def interrupt_fit(self) -> None: raise NotImplementedError
+    def evaluate(self): raise NotImplementedError
+    def get_num_samples(self) -> tuple[int, int]: raise NotImplementedError
+    def init(self) -> None: raise NotImplementedError
+    def close(self) -> None: raise NotImplementedError
+    def finalize_round(self) -> None: raise NotImplementedError
+
+
+class JaxLearner(NodeLearner):
+    """Single-node JAX learner (lightninglearner.py parity).
+
+    Used standalone for one node on one device; federations instead
+    vmap the same ``StepFns`` (see p2pfl_tpu.parallel.federated). Keeps
+    the reference's FL-aware step bookkeeping: ``global_step`` grows by
+    the number of local steps each round
+    (lightninglearner.py:162-165 / statisticslogger.py:131-153).
+    """
+
+    def __init__(self, model=None, data=None, objective="classification",
+                 optimizer="sgd", learning_rate=0.1, momentum=0.9,
+                 weight_decay=0.0, batch_size=32, seed=0, logger=None):
+        self.model = model
+        self.data = data
+        self.objective = objective
+        self.optimizer_name = optimizer
+        self.learning_rate = learning_rate
+        self.momentum = momentum
+        self.weight_decay = weight_decay
+        self.batch_size = batch_size
+        self.seed = seed
+        self.logger = logger
+        self.epochs = 1
+        self.state: TrainState | None = None
+        self.fns: StepFns | None = None
+        self.global_step = 0
+        self.local_step = 0
+        self.round = 0
+        self._interrupted = False
+
+    # -- wiring ----------------------------------------------------------
+    def set_model(self, model) -> None:
+        self.model = model
+        self.fns = None
+
+    def set_data(self, data) -> None:
+        self.data = data
+
+    def create_trainer(self) -> None:
+        """Build + jit the step functions (Trainer-construction analog)."""
+        self.fns = make_step_fns(
+            self.model, objective=self.objective,
+            optimizer=self.optimizer_name, learning_rate=self.learning_rate,
+            momentum=self.momentum, weight_decay=self.weight_decay,
+            batch_size=self.batch_size,
+        )
+        self._train_jit = jax.jit(self.fns.train_epochs,
+                                  static_argnames=("epochs",))
+        self._eval_jit = jax.jit(self.fns.evaluate)
+
+    def init(self) -> None:
+        if self.fns is None:
+            self.create_trainer()
+        rng = jax.random.PRNGKey(self.seed)
+        sample = jnp.asarray(self.data.x[:1])
+        self.state = jax.jit(self.fns.init)(rng, sample)
+
+    # -- parameters ------------------------------------------------------
+    def get_parameters(self):
+        return self.state.params
+
+    def set_parameters(self, params) -> None:
+        check_parameters(params, self.state.params)
+        params = jax.tree.map(
+            lambda new, old: jnp.asarray(new, old.dtype), params,
+            self.state.params,
+        )
+        self.state = self.state.replace(params=params)
+
+    def check_parameters(self, params) -> bool:
+        try:
+            check_parameters(params, self.state.params)
+            return True
+        except Exception:
+            return False
+
+    def encode_parameters(self, params=None, contributors=None, weight=1) -> bytes:
+        if params is None:
+            params = self.get_parameters()
+        return encode_parameters(params, tuple(contributors or ()), weight)
+
+    def decode_parameters(self, data: bytes):
+        return decode_parameters(data)
+
+    # -- training --------------------------------------------------------
+    def set_epochs(self, epochs: int) -> None:
+        self.epochs = epochs
+
+    def fit(self) -> None:
+        if self.epochs <= 0:
+            return
+        if self._interrupted:  # honor a pending interrupt_fit()
+            self._interrupted = False
+            return
+        x = jnp.asarray(self.data.x)
+        y = jnp.asarray(self.data.y)
+        mask = jnp.ones(len(self.data.x), bool)
+        t0 = time.monotonic()
+        self.state, metrics = self._train_jit(self.state, x, y, mask,
+                                              epochs=self.epochs)
+        steps = max(len(self.data.x) // self.batch_size, 1) * self.epochs
+        self.local_step = steps
+        if self.logger is not None:
+            self.logger.log_metrics(
+                {"Train/loss": float(metrics["loss"]),
+                 "Train/epoch_time_s": (time.monotonic() - t0) / self.epochs},
+                step=self.global_step + steps, round=self.round,
+            )
+
+    def interrupt_fit(self) -> None:
+        """Best-effort stop (lightninglearner.py:122-125). A jitted
+        fit is a single device program, so interruption takes effect at
+        the next fit call."""
+        self._interrupted = True
+
+    def evaluate(self):
+        x = jnp.asarray(self.data.x_val if len(self.data.x_val) else self.data.x)
+        y = jnp.asarray(self.data.y_val if len(self.data.x_val) else self.data.y)
+        mask = jnp.ones(len(x), bool)
+        metrics = self._eval_jit(self.state.params, x, y, mask)
+        out = {k: float(v) for k, v in metrics.items()}
+        if self.logger is not None:
+            self.logger.log_metrics(
+                {f"Val/{k}": v for k, v in out.items()},
+                step=self.global_step + self.local_step, round=self.round,
+            )
+        return out
+
+    def get_num_samples(self) -> tuple[int, int]:
+        return (self.data.n_samples, len(self.data.x_val))
+
+    # -- lifecycle -------------------------------------------------------
+    def finalize_round(self) -> None:
+        """Step bookkeeping parity (lightninglearner.py:159-165)."""
+        self.global_step += self.local_step
+        self.local_step = 0
+        self.round += 1
+
+    def close(self) -> None:
+        self.state = None

@@ -1,0 +1,43 @@
+"""Model zoo: flax modules for every workload family the reference ships.
+
+Reference inventory (SURVEY.md §2.4, fedstellar/learning/pytorch/*):
+MNIST MLP/CNN, FEMNIST CNN, CIFAR10 ResNet9/18/34/50 + two MobileNets,
+SYSCALL MLP/Autoencoder/One-class-SVM, WADI MLP — plus ViT-Tiny for the
+stretch config in BASELINE.json.
+
+TPU-first design notes:
+- Normalization is **GroupNorm**, not BatchNorm: batch statistics are
+  known-pathological under non-IID federated data, and GroupNorm keeps
+  the model a *pure* param pytree (no mutable batch_stats collection to
+  gossip separately), which keeps every federated collective a single
+  fixed-shape tree op.
+- All modules take ``dtype`` (compute) and ``param_dtype`` so the MXU
+  path runs bfloat16 with float32 params by default.
+"""
+
+from p2pfl_tpu.models.base import get_model, list_models, register_model
+from p2pfl_tpu.models.mlp import MLP, MNISTModelMLP, SyscallModelMLP, WADIModelMLP
+from p2pfl_tpu.models.cnn import FEMNISTModelCNN, MNISTModelCNN
+from p2pfl_tpu.models.resnet import CIFAR10ModelResNet, ResNet
+from p2pfl_tpu.models.mobilenet import FasterMobileNet, SimpleMobileNet
+from p2pfl_tpu.models.syscall import SyscallModelAutoencoder, SyscallModelOneClassSVM
+from p2pfl_tpu.models.vit import ViT
+
+__all__ = [
+    "get_model",
+    "list_models",
+    "register_model",
+    "MLP",
+    "MNISTModelMLP",
+    "SyscallModelMLP",
+    "WADIModelMLP",
+    "MNISTModelCNN",
+    "FEMNISTModelCNN",
+    "ResNet",
+    "CIFAR10ModelResNet",
+    "FasterMobileNet",
+    "SimpleMobileNet",
+    "SyscallModelAutoencoder",
+    "SyscallModelOneClassSVM",
+    "ViT",
+]

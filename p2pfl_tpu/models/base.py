@@ -1,0 +1,46 @@
+"""Model registry.
+
+Successor of the reference's if/elif model factory in
+fedstellar/node_start.py:46-85 (model chosen by string from
+``model_args.model``): an explicit registry keyed by
+``(dataset, model)`` aliases, returning constructed flax modules.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import flax.linen as nn
+
+_REGISTRY: dict[str, Callable[..., nn.Module]] = {}
+
+
+def register_model(*names: str):
+    """Decorator registering a model factory under one or more names."""
+
+    def deco(fn: Callable[..., nn.Module]):
+        for name in names:
+            key = name.lower()
+            if key in _REGISTRY:
+                raise ValueError(f"model name {name!r} already registered")
+            _REGISTRY[key] = fn
+        return fn
+
+    return deco
+
+
+def get_model(name: str, **kwargs) -> nn.Module:
+    """Build a model by registry name.
+
+    Names mirror the reference's ``model_args.model`` strings
+    (node_start.py:46-85): e.g. ``mlp``/``mnist-mlp``, ``mnist-cnn``,
+    ``femnist-cnn``, ``resnet9``, ``simplemobilenet``.
+    """
+    key = name.lower()
+    if key not in _REGISTRY:
+        raise ValueError(f"unknown model {name!r}; have {sorted(_REGISTRY)}")
+    return _REGISTRY[key](**kwargs)
+
+
+def list_models() -> list[str]:
+    return sorted(_REGISTRY)

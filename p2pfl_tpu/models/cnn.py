@@ -1,0 +1,58 @@
+"""Small convnets for MNIST / FEMNIST.
+
+Capability parity with the reference's MNISTModelCNN
+(fedstellar/learning/pytorch/mnist/models/cnn.py) and FEMNISTModelCNN
+(femnist/models/cnn.py — the LEAF CNN: two 5×5 conv blocks + 2048-wide
+dense, 62 classes). NHWC layout (XLA's native conv layout on TPU),
+bfloat16 compute.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from p2pfl_tpu.models.base import register_model
+
+
+class SmallCNN(nn.Module):
+    """conv(k×k,c1) → pool → conv(k×k,c2) → pool → dense(hidden) → logits."""
+
+    channels: tuple[int, int] = (32, 64)
+    kernel: int = 5
+    hidden: int = 2048
+    num_classes: int = 62
+    dtype: jnp.dtype = jnp.bfloat16
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        if x.ndim == 3:
+            x = x[..., None]  # HW → HWC
+        x = x.astype(self.dtype)
+        k = (self.kernel, self.kernel)
+        for c in self.channels:
+            x = nn.Conv(c, k, padding="SAME", dtype=self.dtype,
+                        param_dtype=self.param_dtype)(x)
+            x = nn.relu(x)
+            x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(self.hidden, dtype=self.dtype, param_dtype=self.param_dtype)(x)
+        x = nn.relu(x)
+        x = nn.Dense(self.num_classes, dtype=self.dtype,
+                     param_dtype=self.param_dtype)(x)
+        return x.astype(jnp.float32)
+
+
+@register_model("mnist-cnn", "cnn", "mnistmodelcnn")
+def MNISTModelCNN(num_classes: int = 10, **kw) -> SmallCNN:
+    return SmallCNN(channels=(32, 64), kernel=3, hidden=512,
+                    num_classes=num_classes, **kw)
+
+
+@register_model("femnist-cnn", "femnistmodelcnn")
+def FEMNISTModelCNN(num_classes: int = 62, **kw) -> SmallCNN:
+    """The LEAF FEMNIST CNN shape — the north-star workload
+    (BASELINE.json: 64-node FEMNIST-CNN federation)."""
+    return SmallCNN(channels=(32, 64), kernel=5, hidden=2048,
+                    num_classes=num_classes, **kw)

@@ -1,0 +1,58 @@
+"""MLP family.
+
+Covers the reference's MNISTModelMLP
+(fedstellar/learning/pytorch/mnist/models/mlp.py:144-146 — 784→256→128→10),
+SyscallModelMLP (syscall/models/mlp.py) and WADIModelMLP
+(wadi/models/mlp.py), as one parameterized flax module.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from p2pfl_tpu.models.base import register_model
+
+
+class MLP(nn.Module):
+    """Flatten → stack of Dense+ReLU → logits.
+
+    Compute in ``dtype`` (bfloat16 by default → MXU), params in
+    ``param_dtype``.
+    """
+
+    features: Sequence[int] = (256, 128)
+    num_classes: int = 10
+    dtype: jnp.dtype = jnp.bfloat16
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.reshape((x.shape[0], -1)).astype(self.dtype)
+        for f in self.features:
+            x = nn.Dense(f, dtype=self.dtype, param_dtype=self.param_dtype)(x)
+            x = nn.relu(x)
+        x = nn.Dense(
+            self.num_classes, dtype=self.dtype, param_dtype=self.param_dtype
+        )(x)
+        return x.astype(jnp.float32)
+
+
+@register_model("mlp", "mnist-mlp", "mnistmodelmlp")
+def MNISTModelMLP(num_classes: int = 10, **kw) -> MLP:
+    """784→256→128→10, matching the reference's MNIST MLP shape."""
+    return MLP(features=(256, 128), num_classes=num_classes, **kw)
+
+
+@register_model("syscall-mlp", "syscallmodelmlp")
+def SyscallModelMLP(in_features: int = 17, num_classes: int = 9, **kw) -> MLP:
+    """Tabular syscall-trace classifier (syscall/models/mlp.py analog)."""
+    return MLP(features=(64, 64), num_classes=num_classes, **kw)
+
+
+@register_model("wadi-mlp", "wadimodelmlp")
+def WADIModelMLP(in_features: int = 123, num_classes: int = 2, **kw) -> MLP:
+    """WADI anomaly-detection MLP (wadi/models/mlp.py analog)."""
+    return MLP(features=(128, 64, 32), num_classes=num_classes, **kw)

@@ -1,0 +1,80 @@
+"""MobileNet variants for CIFAR-10.
+
+Capability parity with the reference's FasterMobileNet
+(fedstellar/learning/pytorch/cifar10/models/fastermobilenet.py) and
+SimpleMobileNetV1 (simplemobilenet.py): depthwise-separable conv
+stacks. GroupNorm for federated friendliness; NHWC bfloat16.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from p2pfl_tpu.models.base import register_model
+
+
+class DepthwiseSeparable(nn.Module):
+    features: int
+    strides: int = 1
+    dtype: jnp.dtype = jnp.bfloat16
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        in_feats = x.shape[-1]
+        x = nn.Conv(in_feats, (3, 3), strides=(self.strides,) * 2, padding="SAME",
+                    feature_group_count=in_feats, use_bias=False,
+                    dtype=self.dtype, param_dtype=self.param_dtype)(x)
+        x = nn.GroupNorm(num_groups=min(32, in_feats), dtype=self.dtype,
+                         param_dtype=self.param_dtype)(x)
+        x = nn.relu(x)
+        x = nn.Conv(self.features, (1, 1), use_bias=False,
+                    dtype=self.dtype, param_dtype=self.param_dtype)(x)
+        x = nn.GroupNorm(num_groups=min(32, self.features), dtype=self.dtype,
+                         param_dtype=self.param_dtype)(x)
+        return nn.relu(x)
+
+
+class MobileNet(nn.Module):
+    """Stem conv + depthwise-separable blocks + linear head."""
+
+    blocks: Sequence[tuple[int, int]] = ((64, 1), (128, 2), (128, 1), (256, 2))
+    stem: int = 32
+    num_classes: int = 10
+    dtype: jnp.dtype = jnp.bfloat16
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.astype(self.dtype)
+        x = nn.Conv(self.stem, (3, 3), padding="SAME", use_bias=False,
+                    dtype=self.dtype, param_dtype=self.param_dtype)(x)
+        x = nn.GroupNorm(num_groups=min(32, self.stem), dtype=self.dtype,
+                         param_dtype=self.param_dtype)(x)
+        x = nn.relu(x)
+        for feats, strides in self.blocks:
+            x = DepthwiseSeparable(feats, strides=strides, dtype=self.dtype,
+                                   param_dtype=self.param_dtype)(x)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=self.dtype,
+                     param_dtype=self.param_dtype)(x)
+        return x.astype(jnp.float32)
+
+
+@register_model("fastermobilenet")
+def FasterMobileNet(num_classes: int = 10, **kw) -> MobileNet:
+    """Small 4-block variant (fastermobilenet.py analog)."""
+    return MobileNet(blocks=((64, 1), (128, 2), (128, 1), (256, 2)),
+                     num_classes=num_classes, **kw)
+
+
+@register_model("simplemobilenet", "simplemobilenetv1")
+def SimpleMobileNet(num_classes: int = 10, **kw) -> MobileNet:
+    """Fuller MobileNetV1-style stack (simplemobilenet.py analog)."""
+    return MobileNet(
+        blocks=((64, 1), (128, 2), (128, 1), (256, 2), (256, 1), (512, 2),
+                (512, 1), (512, 1)),
+        num_classes=num_classes, **kw)

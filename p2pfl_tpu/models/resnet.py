@@ -1,0 +1,179 @@
+"""ResNets for CIFAR-10.
+
+Capability parity with the reference's CIFAR10ModelResNet
+(fedstellar/learning/pytorch/cifar10/models/resnet.py:23-36,174-201 —
+a hand-built resnet9 plus resnet18/34/50 via a classifier dict).
+
+TPU-first choices: NHWC, bfloat16 compute, GroupNorm instead of
+BatchNorm (pure param pytree; robust under non-IID federated data).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from p2pfl_tpu.models.base import register_model
+
+
+def _gn(groups: int, dtype, param_dtype):
+    return nn.GroupNorm(num_groups=groups, dtype=dtype, param_dtype=param_dtype)
+
+
+class ConvBlock(nn.Module):
+    features: int
+    pool: bool = False
+    dtype: jnp.dtype = jnp.bfloat16
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.Conv(self.features, (3, 3), padding="SAME", use_bias=False,
+                    dtype=self.dtype, param_dtype=self.param_dtype)(x)
+        x = _gn(min(32, self.features), self.dtype, self.param_dtype)(x)
+        x = nn.relu(x)
+        if self.pool:
+            x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        return x
+
+
+class Residual(nn.Module):
+    features: int
+    dtype: jnp.dtype = jnp.bfloat16
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        y = ConvBlock(self.features, dtype=self.dtype,
+                      param_dtype=self.param_dtype)(x)
+        y = ConvBlock(self.features, dtype=self.dtype,
+                      param_dtype=self.param_dtype)(y)
+        return x + y
+
+
+class ResNet9(nn.Module):
+    """The fast CIFAR ResNet9: prep → 2×(conv-pool + residual) → head."""
+
+    num_classes: int = 10
+    dtype: jnp.dtype = jnp.bfloat16
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.astype(self.dtype)
+        kw = dict(dtype=self.dtype, param_dtype=self.param_dtype)
+        x = ConvBlock(64, **kw)(x)
+        x = ConvBlock(128, pool=True, **kw)(x)
+        x = Residual(128, **kw)(x)
+        x = ConvBlock(256, pool=True, **kw)(x)
+        x = ConvBlock(512, pool=True, **kw)(x)
+        x = Residual(512, **kw)(x)
+        x = jnp.max(x, axis=(1, 2))  # global max pool
+        x = nn.Dense(self.num_classes, dtype=self.dtype,
+                     param_dtype=self.param_dtype)(x)
+        return x.astype(jnp.float32) * 0.125  # resnet9 logit scaling
+
+
+class BasicBlock(nn.Module):
+    features: int
+    strides: int = 1
+    dtype: jnp.dtype = jnp.bfloat16
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        kw = dict(use_bias=False, dtype=self.dtype, param_dtype=self.param_dtype)
+        y = nn.Conv(self.features, (3, 3), strides=(self.strides,) * 2,
+                    padding="SAME", **kw)(x)
+        y = _gn(min(32, self.features), self.dtype, self.param_dtype)(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.features, (3, 3), padding="SAME", **kw)(y)
+        y = _gn(min(32, self.features), self.dtype, self.param_dtype)(y)
+        if x.shape != y.shape:
+            x = nn.Conv(self.features, (1, 1), strides=(self.strides,) * 2, **kw)(x)
+            x = _gn(min(32, self.features), self.dtype, self.param_dtype)(x)
+        return nn.relu(x + y)
+
+
+class Bottleneck(nn.Module):
+    features: int
+    strides: int = 1
+    dtype: jnp.dtype = jnp.bfloat16
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        kw = dict(use_bias=False, dtype=self.dtype, param_dtype=self.param_dtype)
+        out = self.features * 4
+        y = nn.Conv(self.features, (1, 1), **kw)(x)
+        y = _gn(min(32, self.features), self.dtype, self.param_dtype)(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.features, (3, 3), strides=(self.strides,) * 2,
+                    padding="SAME", **kw)(y)
+        y = _gn(min(32, self.features), self.dtype, self.param_dtype)(y)
+        y = nn.relu(y)
+        y = nn.Conv(out, (1, 1), **kw)(y)
+        y = _gn(min(32, out), self.dtype, self.param_dtype)(y)
+        if x.shape != y.shape:
+            x = nn.Conv(out, (1, 1), strides=(self.strides,) * 2, **kw)(x)
+            x = _gn(min(32, out), self.dtype, self.param_dtype)(x)
+        return nn.relu(x + y)
+
+
+class ResNet(nn.Module):
+    """Generic CIFAR-style ResNet-{18,34,50} (3×3 stem, no max-pool)."""
+
+    stage_sizes: Sequence[int] = (2, 2, 2, 2)
+    bottleneck: bool = False
+    num_classes: int = 10
+    dtype: jnp.dtype = jnp.bfloat16
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.astype(self.dtype)
+        x = nn.Conv(64, (3, 3), padding="SAME", use_bias=False,
+                    dtype=self.dtype, param_dtype=self.param_dtype)(x)
+        x = _gn(32, self.dtype, self.param_dtype)(x)
+        x = nn.relu(x)
+        block = Bottleneck if self.bottleneck else BasicBlock
+        for stage, n_blocks in enumerate(self.stage_sizes):
+            feats = 64 * (2**stage)
+            for b in range(n_blocks):
+                strides = 2 if (stage > 0 and b == 0) else 1
+                x = block(feats, strides=strides, dtype=self.dtype,
+                          param_dtype=self.param_dtype)(x)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=self.dtype,
+                     param_dtype=self.param_dtype)(x)
+        return x.astype(jnp.float32)
+
+
+@register_model("resnet9", "cifar10-resnet9", "cifar10modelresnet")
+def _resnet9(num_classes: int = 10, **kw) -> ResNet9:
+    return ResNet9(num_classes=num_classes, **kw)
+
+
+@register_model("resnet18", "cifar10-resnet18")
+def _resnet18(num_classes: int = 10, **kw) -> ResNet:
+    return ResNet(stage_sizes=(2, 2, 2, 2), num_classes=num_classes, **kw)
+
+
+@register_model("resnet34", "cifar10-resnet34")
+def _resnet34(num_classes: int = 10, **kw) -> ResNet:
+    return ResNet(stage_sizes=(3, 4, 6, 3), num_classes=num_classes, **kw)
+
+
+@register_model("resnet50", "cifar10-resnet50")
+def _resnet50(num_classes: int = 10, **kw) -> ResNet:
+    return ResNet(stage_sizes=(3, 4, 6, 3), bottleneck=True,
+                  num_classes=num_classes, **kw)
+
+
+def CIFAR10ModelResNet(depth: int = 9, **kw) -> nn.Module:
+    """Factory matching the reference's classifier-dict style
+    (cifar10/models/resnet.py:23-36)."""
+    factories = {9: _resnet9, 18: _resnet18, 34: _resnet34, 50: _resnet50}
+    return factories[depth](**kw)

@@ -1,0 +1,68 @@
+"""SYSCALL behavioral-fingerprinting models.
+
+Capability parity with the reference's SyscallModelAutoencoder
+(fedstellar/learning/pytorch/syscall/models/autoencoder.py) and
+SyscallModelSGDOneClassSVM (svm.py). The MLP classifier lives in
+p2pfl_tpu.models.mlp.
+
+The one-class SVM is the linear ν-OCSVM trained by SGD: score
+``w·x − ρ``; its loss (see p2pfl_tpu.learning.objectives.ocsvm_loss)
+is ``½‖w‖² + 1/ν · mean(max(0, ρ − w·x)) − ρ`` — the same objective
+sklearn's SGDOneClassSVM optimizes in the reference.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from p2pfl_tpu.models.base import register_model
+
+
+class Autoencoder(nn.Module):
+    """Dense autoencoder; anomaly score = reconstruction error."""
+
+    in_features: int = 17
+    encoder: Sequence[int] = (64, 16)
+    dtype: jnp.dtype = jnp.bfloat16
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.reshape((x.shape[0], -1)).astype(self.dtype)
+        for f in self.encoder:
+            x = nn.relu(nn.Dense(f, dtype=self.dtype,
+                                 param_dtype=self.param_dtype)(x))
+        for f in reversed(self.encoder[:-1]):
+            x = nn.relu(nn.Dense(f, dtype=self.dtype,
+                                 param_dtype=self.param_dtype)(x))
+        x = nn.Dense(self.in_features, dtype=self.dtype,
+                     param_dtype=self.param_dtype)(x)
+        return x.astype(jnp.float32)
+
+
+class OneClassSVM(nn.Module):
+    """Linear one-class SVM head: returns decision scores ``w·x − ρ``."""
+
+    in_features: int = 17
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.reshape((x.shape[0], -1)).astype(jnp.float32)
+        w = self.param("w", nn.initializers.zeros, (self.in_features,),
+                       self.param_dtype)
+        rho = self.param("rho", nn.initializers.zeros, (), self.param_dtype)
+        return x @ w - rho
+
+
+@register_model("syscall-autoencoder", "syscallmodelautoencoder")
+def SyscallModelAutoencoder(in_features: int = 17, **kw) -> Autoencoder:
+    return Autoencoder(in_features=in_features, **kw)
+
+
+@register_model("syscall-svm", "syscallmodelsgdoneclasssvm")
+def SyscallModelOneClassSVM(in_features: int = 17, **kw) -> OneClassSVM:
+    return OneClassSVM(in_features=in_features, **kw)

@@ -1,0 +1,98 @@
+"""ViT for federated fine-tuning (BASELINE.json stretch config:
+"ViT-Tiny federated fine-tune, 32 nodes, Krum/trimmed-mean").
+
+No counterpart exists in the reference (its largest model is ResNet —
+SURVEY.md §2.9); this is the attention workload that exercises the
+sequence-parallel path in p2pfl_tpu.ops.ring_attention: set
+``seq_axis`` to a mesh axis name and the attention runs blockwise over
+sequence shards with ``ppermute`` K/V rotation over ICI.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from p2pfl_tpu.models.base import register_model
+
+
+class TransformerBlock(nn.Module):
+    dim: int
+    heads: int
+    mlp_ratio: int = 4
+    dtype: jnp.dtype = jnp.bfloat16
+    param_dtype: jnp.dtype = jnp.float32
+    seq_axis: str | None = None  # mesh axis for ring attention
+
+    @nn.compact
+    def __call__(self, x):
+        y = nn.LayerNorm(dtype=self.dtype, param_dtype=self.param_dtype)(x)
+        if self.seq_axis is not None:
+            from p2pfl_tpu.ops.ring_attention import ring_self_attention
+
+            y = ring_self_attention(
+                nn.DenseGeneral((self.heads, self.dim // self.heads),
+                                dtype=self.dtype, param_dtype=self.param_dtype,
+                                name="query")(y),
+                nn.DenseGeneral((self.heads, self.dim // self.heads),
+                                dtype=self.dtype, param_dtype=self.param_dtype,
+                                name="key")(y),
+                nn.DenseGeneral((self.heads, self.dim // self.heads),
+                                dtype=self.dtype, param_dtype=self.param_dtype,
+                                name="value")(y),
+                axis_name=self.seq_axis,
+            )
+            y = nn.DenseGeneral(self.dim, axis=(-2, -1), dtype=self.dtype,
+                                param_dtype=self.param_dtype, name="out")(y)
+        else:
+            y = nn.MultiHeadDotProductAttention(
+                num_heads=self.heads, dtype=self.dtype,
+                param_dtype=self.param_dtype)(y, y)
+        x = x + y
+        y = nn.LayerNorm(dtype=self.dtype, param_dtype=self.param_dtype)(x)
+        y = nn.Dense(self.dim * self.mlp_ratio, dtype=self.dtype,
+                     param_dtype=self.param_dtype)(y)
+        y = nn.gelu(y)
+        y = nn.Dense(self.dim, dtype=self.dtype, param_dtype=self.param_dtype)(y)
+        return x + y
+
+
+class ViT(nn.Module):
+    """ViT-Tiny by default: patch 4 (CIFAR-scale), dim 192, 12 layers."""
+
+    patch: int = 4
+    dim: int = 192
+    depth: int = 12
+    heads: int = 3
+    num_classes: int = 10
+    dtype: jnp.dtype = jnp.bfloat16
+    param_dtype: jnp.dtype = jnp.float32
+    seq_axis: str | None = None
+
+    @nn.compact
+    def __call__(self, x):
+        if x.ndim == 3:
+            x = x[..., None]
+        x = x.astype(self.dtype)
+        x = nn.Conv(self.dim, (self.patch, self.patch),
+                    strides=(self.patch, self.patch), dtype=self.dtype,
+                    param_dtype=self.param_dtype, name="patch_embed")(x)
+        b, h, w, c = x.shape
+        x = x.reshape(b, h * w, c)
+        pos = self.param("pos_embed", nn.initializers.normal(0.02),
+                         (1, h * w, c), self.param_dtype)
+        x = x + pos.astype(self.dtype)
+        for _ in range(self.depth):
+            x = TransformerBlock(self.dim, self.heads, dtype=self.dtype,
+                                 param_dtype=self.param_dtype,
+                                 seq_axis=self.seq_axis)(x)
+        x = nn.LayerNorm(dtype=self.dtype, param_dtype=self.param_dtype)(x)
+        x = jnp.mean(x, axis=1)
+        x = nn.Dense(self.num_classes, dtype=self.dtype,
+                     param_dtype=self.param_dtype)(x)
+        return x.astype(jnp.float32)
+
+
+@register_model("vit-tiny", "vit")
+def _vit_tiny(num_classes: int = 10, **kw) -> ViT:
+    return ViT(num_classes=num_classes, **kw)

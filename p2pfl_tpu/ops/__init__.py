@@ -1,0 +1,17 @@
+"""TPU compute ops: sequence-parallel attention, fused kernels.
+
+The reference has no native/accelerator ops of its own (SURVEY.md §0:
+100% Python over torch kernels); this package is where the TPU build
+keeps its hot custom ops:
+
+- ``ring_attention``: blockwise self-attention with K/V rotation via
+  ``ppermute`` over a mesh axis — sequence/context parallelism for the
+  long-context path (ViT & transformer workloads).
+- ``ulysses_attention``: all-to-all (DeepSpeed-Ulysses-style) sequence
+  parallelism — heads sharded during attention, sequence sharded
+  elsewhere.
+"""
+
+from p2pfl_tpu.ops.ring_attention import ring_self_attention, ulysses_attention
+
+__all__ = ["ring_self_attention", "ulysses_attention"]

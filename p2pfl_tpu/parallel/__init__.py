@@ -1,0 +1,36 @@
+"""Parallel layer: device mesh, ICI transport, SPMD federated rounds.
+
+This is the TPU-native replacement for the reference's entire P2P
+runtime (fedstellar/base_node.py, node_connection.py, gossiper.py,
+communication_protocol.py — threads, TCP sockets, 2 KB fragments,
+pickle): federated node *i* lives at mesh position *i* along a
+``nodes`` axis; a whole federated round (local epochs → neighbor
+weight exchange → per-node aggregation → metrics) is ONE jit-compiled
+XLA program. Weight "gossip" is a masked collective over ICI, not a
+1 Hz socket loop.
+"""
+
+from p2pfl_tpu.parallel.mesh import (
+    federation_mesh,
+    shard_stacked,
+    stacked_sharding,
+)
+from p2pfl_tpu.parallel.federated import (
+    FederatedState,
+    build_round_fn,
+    init_federation,
+    make_mixing_matrix,
+)
+from p2pfl_tpu.parallel.transport import MeshTransport, neighbor_exchange
+
+__all__ = [
+    "federation_mesh",
+    "shard_stacked",
+    "stacked_sharding",
+    "FederatedState",
+    "build_round_fn",
+    "init_federation",
+    "make_mixing_matrix",
+    "MeshTransport",
+    "neighbor_exchange",
+]

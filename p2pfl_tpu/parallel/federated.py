@@ -1,0 +1,227 @@
+"""The federated round as one SPMD program.
+
+Reference semantics being reproduced (fedstellar/node.py round state
+machine, SURVEY.md §3.3-3.4), re-expressed as fixed-shape device math:
+
+- every node trains local epochs      → vmapped ``lax.scan`` training
+- weights flow along topology edges   → masked collective (an einsum
+  over the gathered node axis; XLA lowers the gather to all-gather
+  over ICI when the node axis is sharded)
+- each aggregator fuses what arrived  → per-row weighted FedAvg (or a
+  robust aggregator vmapped over rows)
+- trainers/idle adopt an aggregate    → ``adopt`` index gather
+- dead nodes (heartbeat eviction / fault injection) → ``alive`` mask:
+  they neither contribute weight nor update their own params.
+
+Per-round *data* (who aggregates whom ``M``, whose aggregate each node
+adopts ``adopt``, who is alive) are device arrays, not compile-time
+constants — so DFL, CFL, SDFL leadership rotation, and mid-run faults
+all reuse ONE compiled program.
+
+The three federation schemes map as (node.py:427-524 role branches):
+- DFL:  M = adjacency + self-loops; adopt = identity.
+- CFL:  M[server] = everyone; adopt = server for all nodes.
+- SDFL: like CFL with the current leader; leader rotates on the host
+        (node.py:649-686 TRANSFER_LEADERSHIP analog).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+from p2pfl_tpu.core.aggregators import Aggregator, FedAvg
+from p2pfl_tpu.learning.learner import StepFns, TrainState
+from p2pfl_tpu.topology.topology import Topology
+
+Params = Any
+
+
+class FederatedState(struct.PyTreeNode):
+    """Whole-federation state: every leaf has a leading ``[n]`` axis."""
+
+    states: TrainState  # stacked per-node TrainState
+    alive: jax.Array  # [n] bool
+    round: jax.Array  # scalar int32
+
+
+@dataclasses.dataclass(frozen=True)
+class RoundPlan:
+    """Host-computed per-round schedule, fed to the jitted round fn.
+
+    ``mix``    [n,n] float32 — row i: relative weight of node j's model
+               in i's aggregate (0 = no edge). Sample-count and alive
+               weighting are folded in by the round fn.
+    ``adopt``  [n] int32 — node i installs the aggregate computed at
+               row ``adopt[i]`` (identity for DFL; leader for CFL/SDFL).
+    ``trains`` [n] bool — which nodes run local SGD this round
+               (trainer/aggregator/server yes; proxy/idle no —
+               node.py:492-524).
+    """
+
+    mix: np.ndarray
+    adopt: np.ndarray
+    trains: np.ndarray
+
+
+def make_round_plan(
+    topology: Topology,
+    roles: list[str],
+    federation: str = "DFL",
+    leader: int = 0,
+) -> RoundPlan:
+    n = topology.n
+    trains = np.array([r in ("trainer", "aggregator", "server") for r in roles])
+    if federation == "DFL":
+        mix = topology.adjacency.astype(np.float32) + np.eye(n, dtype=np.float32)
+        adopt = np.arange(n, dtype=np.int32)
+    elif federation in ("CFL", "SDFL"):
+        mix = np.zeros((n, n), np.float32)
+        mix[leader] = 1.0  # leader aggregates everyone (incl. itself)
+        adopt = np.full((n,), leader, np.int32)
+    else:
+        raise ValueError(f"unknown federation {federation!r}")
+    return RoundPlan(mix=mix, adopt=adopt.astype(np.int32), trains=trains)
+
+
+def make_mixing_matrix(topology: Topology, scheme: str = "uniform") -> np.ndarray:
+    """Expose Topology.mixing_matrix at this layer (decentralized-
+    averaging weights; ``W^k`` powers emulate k gossip ticks/round)."""
+    return topology.mixing_matrix(scheme).astype(np.float32)
+
+
+def _tree_sel(cond: jax.Array, a, b):
+    """Per-node select: cond [n] broadcast over each stacked leaf."""
+
+    def leaf(x, y):
+        c = cond.reshape((cond.shape[0],) + (1,) * (x.ndim - 1))
+        return jnp.where(c, x, y)
+
+    return jax.tree.map(leaf, a, b)
+
+
+def init_federation(
+    fns: StepFns, sample_x: jax.Array, n_nodes: int, seed: int = 0,
+    same_init: bool = True,
+) -> FederatedState:
+    """Stacked init. ``same_init=True`` reproduces the reference's
+    initial-model diffusion (node.py:299: every node starts from the
+    initializer's weights) without the gossip: init once, broadcast."""
+    rngs = (
+        jnp.stack([jax.random.PRNGKey(seed)] * n_nodes)
+        if same_init
+        else jax.random.split(jax.random.PRNGKey(seed), n_nodes)
+    )
+    states = jax.vmap(fns.init, in_axes=(0, None))(rngs, sample_x)
+    if same_init:
+        # distinct per-node training rngs even with identical params
+        states = states.replace(
+            rng=jax.vmap(jax.random.fold_in, in_axes=(0, 0))(
+                states.rng, jnp.arange(n_nodes)
+            )
+        )
+    return FederatedState(
+        states=states,
+        alive=jnp.ones((n_nodes,), bool),
+        round=jnp.int32(0),
+    )
+
+
+def build_round_fn(
+    fns: StepFns,
+    aggregator: Aggregator | None = None,
+    epochs: int = 1,
+) -> Callable:
+    """Build the jittable ``round_fn(fed, x, y, mask, n_samples, plan
+    arrays) -> (fed, metrics)``.
+
+    FedAvg gets the fast path: per-leaf ``einsum('ij,j...->i...')`` —
+    one MXU-friendly contraction per leaf, with the row-normalized
+    weight matrix folding topology × alive × sample counts. Robust
+    aggregators (Krum/median/trimmed mean) are vmapped per row over the
+    gathered stack.
+    """
+    aggregator = aggregator or FedAvg()
+    fedavg_fast = type(aggregator) is FedAvg
+
+    def round_fn(fed: FederatedState, x, y, smask, n_samples, mix, adopt, trains):
+        states = fed.states
+        alive = fed.alive
+
+        # ---- local training (every node; results masked in afterward)
+        new_states, train_metrics = jax.vmap(
+            fns.train_epochs, in_axes=(0, 0, 0, 0, None)
+        )(states, x, y, smask, epochs)
+        sel = jnp.logical_and(trains, alive)
+        states = TrainState(
+            params=_tree_sel(sel, new_states.params, states.params),
+            opt_state=_tree_sel(sel, new_states.opt_state, states.opt_state),
+            rng=jnp.where(sel[:, None], new_states.rng, states.rng),
+            step=jnp.where(sel, new_states.step, states.step),
+        )
+
+        # ---- weight exchange + aggregation
+        # contribution gate: only alive *training* nodes inject models
+        # (proxy/idle forward/adopt but never contribute — node.py:492-524)
+        contrib = jnp.logical_and(trains, alive)
+        w = mix * n_samples.astype(jnp.float32)[None, :] * contrib[None, :]
+        if fedavg_fast:
+            denom = jnp.maximum(jnp.sum(w, axis=1, keepdims=True), 1e-9)
+            wn = w / denom
+
+            def leaf_mix(p):
+                flat = p.reshape(p.shape[0], -1).astype(jnp.float32)
+                out = wn @ flat  # [n,n]@[n,d] — MXU
+                return out.reshape(p.shape).astype(p.dtype)
+
+            agg = jax.tree.map(leaf_mix, states.params)
+        else:
+            def per_row(row_w):
+                return aggregator.aggregate(
+                    states.params, n_samples.astype(jnp.float32),
+                    mask=row_w > 0,
+                )
+
+            agg = jax.vmap(per_row)(w)
+
+        # nodes with an all-zero row (nothing arrived before "timeout",
+        # aggregator.py:53-76) keep their own params
+        got_any = jnp.sum(w, axis=1) > 0
+        agg = jax.tree.map(lambda a: a[adopt], agg)
+        keep = jnp.logical_and(alive, got_any[adopt])
+        params = _tree_sel(keep, agg, states.params)
+
+        fed = FederatedState(
+            states=states.replace(params=params),
+            alive=alive,
+            round=fed.round + 1,
+        )
+        metrics = {
+            "train_loss": train_metrics["loss"],  # [n]
+            "alive": alive,
+        }
+        return fed, metrics
+
+    return round_fn
+
+
+def build_eval_fn(fns: StepFns) -> Callable:
+    """Evaluate every node's model on the (replicated) test set.
+
+    Returns per-node metrics ``{loss: [n], accuracy: [n]}`` — the
+    federated analog of the reference's per-node ``__evaluate``
+    (node.py:435, Trainer.test per process).
+    """
+
+    def eval_fn(fed: FederatedState, x_test, y_test):
+        mask = jnp.ones((x_test.shape[0],), bool)
+        return jax.vmap(fns.evaluate, in_axes=(0, None, None, None))(
+            fed.states.params, x_test, y_test, mask
+        )
+
+    return eval_fn

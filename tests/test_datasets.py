@@ -1,0 +1,101 @@
+"""Data pipeline: partitioning semantics, surrogates, stacking.
+
+The partition tests encode the reference's sharding contracts
+(mnist.py:76-118): IID = disjoint equal contiguous shards of a
+shuffle; sorted = label-concentrated shards; plus Dirichlet."""
+
+import numpy as np
+import pytest
+
+from p2pfl_tpu.config.schema import DataConfig
+from p2pfl_tpu.datasets import (
+    FederatedDataset,
+    dirichlet_partition,
+    get_dataset,
+    iid_partition,
+    partition_indices,
+    sorted_partition,
+)
+
+
+def _labels(n=1000, classes=10, seed=0):
+    return np.random.default_rng(seed).integers(0, classes, size=n)
+
+
+def test_iid_partition_disjoint_equal():
+    y = _labels()
+    parts = iid_partition(y, 8, seed=1)
+    assert len(parts) == 8
+    assert all(len(p) == 125 for p in parts)
+    allidx = np.concatenate(parts)
+    assert len(np.unique(allidx)) == len(allidx)
+
+
+def test_sorted_partition_label_concentration():
+    y = _labels(1000, 10)
+    parts = sorted_partition(y, 10)
+    # each shard sees few distinct labels (label-sorted non-IID)
+    for p in parts:
+        assert len(np.unique(y[p])) <= 3
+
+
+def test_dirichlet_partition_properties():
+    y = _labels(2000, 10)
+    parts = dirichlet_partition(y, 8, alpha=0.3, seed=0)
+    assert min(len(p) for p in parts) >= 2
+    allidx = np.concatenate(parts)
+    assert len(np.unique(allidx)) == len(allidx)
+    # lower alpha → more skew than iid: some node's label dist is peaked
+    maxfrac = max(
+        np.bincount(y[p], minlength=10).max() / len(p) for p in parts
+    )
+    assert maxfrac > 0.25
+
+
+def test_partition_factory():
+    y = _labels()
+    assert len(partition_indices(y, 4, "iid")) == 4
+    with pytest.raises(ValueError):
+        partition_indices(y, 4, "bogus")
+
+
+def test_synthetic_dataset_deterministic():
+    a = get_dataset("mnist", seed=3)
+    b = get_dataset("mnist", seed=3)
+    assert a.synthetic
+    np.testing.assert_array_equal(a.x_train, b.x_train)
+    assert a.x_train.shape[1:] == (28, 28, 1)
+    assert a.num_classes == 10
+    c = get_dataset("mnist", seed=4)
+    assert not np.array_equal(a.x_train, c.x_train)
+
+
+@pytest.mark.parametrize("name,shape,classes", [
+    ("femnist", (28, 28, 1), 62),
+    ("cifar10", (32, 32, 3), 10),
+    ("syscall", (17,), 9),
+    ("wadi", (123,), 2),
+])
+def test_all_dataset_families(name, shape, classes):
+    ds = get_dataset(name, synthetic_sizes=(500, 100))
+    assert ds.input_shape == shape
+    assert ds.num_classes == classes
+    assert ds.y_train.max() < classes
+
+
+def test_federated_stacking_padding():
+    cfg = DataConfig(dataset="mnist", partition="dirichlet", dirichlet_alpha=0.3)
+    fed = FederatedDataset.make(cfg, 4)
+    x, y, mask, ns = fed.stacked()
+    assert x.shape[0] == 4 and mask.shape == y.shape
+    for i in range(4):
+        assert mask[i].sum() == fed.nodes[i].n_samples == ns[i]
+        # padding rows are zero and masked out
+        assert not mask[i, ns[i]:].any()
+
+
+def test_val_split_fraction():
+    cfg = DataConfig(dataset="mnist", val_percent=0.2, samples_per_node=500)
+    fed = FederatedDataset.make(cfg, 2)
+    nd = fed.nodes[0]
+    assert len(nd.x_val) == 100 and nd.n_samples == 400

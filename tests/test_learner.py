@@ -1,0 +1,72 @@
+"""JaxLearner: the NodeLearner contract in action.
+
+Covers the behaviors the reference's LightningLearner carries
+(lightninglearner.py): fit improves loss, params round-trip through
+the wire encoding, shape validation rejects foreign models, FL-round
+step bookkeeping accumulates."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from p2pfl_tpu.config.schema import DataConfig
+from p2pfl_tpu.core.serialize import ModelNotMatchingError
+from p2pfl_tpu.datasets import FederatedDataset
+from p2pfl_tpu.learning import JaxLearner
+from p2pfl_tpu.models import get_model
+
+
+@pytest.fixture(scope="module")
+def learner():
+    fed = FederatedDataset.make(
+        DataConfig(dataset="mnist", samples_per_node=600), 1
+    )
+    ln = JaxLearner(model=get_model("mnist-mlp"), data=fed.nodes[0],
+                    learning_rate=0.05, seed=0)
+    ln.init()
+    return ln
+
+
+def test_fit_improves(learner):
+    before = learner.evaluate()
+    learner.set_epochs(2)
+    learner.fit()
+    after = learner.evaluate()
+    assert after["loss"] < before["loss"]
+    assert after["accuracy"] > before["accuracy"]
+
+
+def test_param_roundtrip(learner):
+    blob = learner.encode_parameters(contributors=(0, 3), weight=540)
+    payload = learner.decode_parameters(blob)
+    assert payload.contributors == (0, 3)
+    assert payload.weight == 540
+    assert learner.check_parameters(payload.params)
+    learner.set_parameters(payload.params)
+
+
+def test_reject_foreign_model(learner):
+    other = get_model("femnist-cnn")
+    params = other.init(jax.random.PRNGKey(0), jnp.zeros((1, 28, 28, 1)))
+    assert not learner.check_parameters(params)
+    with pytest.raises(ModelNotMatchingError):
+        learner.set_parameters(params)
+
+
+def test_round_bookkeeping(learner):
+    learner.set_epochs(1)
+    learner.fit()
+    steps = learner.local_step
+    assert steps == len(learner.data.x) // learner.batch_size
+    g0 = learner.global_step
+    learner.finalize_round()
+    assert learner.global_step == g0 + steps
+    assert learner.local_step == 0
+    assert learner.round >= 1
+
+
+def test_num_samples(learner):
+    n_train, n_val = learner.get_num_samples()
+    assert n_train == len(learner.data.x)
+    assert n_val == len(learner.data.x_val)

@@ -1,0 +1,68 @@
+"""Model zoo: init/apply shapes, dtype policy, registry.
+
+Mirrors what the reference never tested (SURVEY.md §4) for its model
+files (fedstellar/learning/pytorch/*/models/*)."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from p2pfl_tpu.models import get_model, list_models
+
+CASES = [
+    ("mnist-mlp", (2, 28, 28, 1), (2, 10)),
+    ("mnist-cnn", (2, 28, 28, 1), (2, 10)),
+    ("femnist-cnn", (2, 28, 28, 1), (2, 62)),
+    ("resnet9", (2, 16, 16, 3), (2, 10)),
+    ("fastermobilenet", (2, 16, 16, 3), (2, 10)),
+    ("syscall-mlp", (2, 17), (2, 9)),
+    ("wadi-mlp", (2, 123), (2, 2)),
+    ("syscall-autoencoder", (2, 17), (2, 17)),
+    ("syscall-svm", (2, 17), (2,)),
+]
+
+
+@pytest.mark.parametrize("name,in_shape,out_shape", CASES)
+def test_model_shapes(name, in_shape, out_shape):
+    model = get_model(name)
+    rng = jax.random.PRNGKey(0)
+    params = model.init(rng, jnp.zeros(in_shape))
+    out = model.apply(params, jnp.zeros(in_shape))
+    assert out.shape == out_shape
+    assert out.dtype == jnp.float32  # logits always f32 for stable loss
+
+
+def test_vit_tiny_small():
+    model = get_model("vit-tiny", dim=32, depth=2, heads=2)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((2, 16, 16, 3)))
+    out = model.apply(params, jnp.zeros((2, 16, 16, 3)))
+    assert out.shape == (2, 10)
+
+
+def test_params_are_pure_pytree():
+    """GroupNorm choice keeps params a single collection (no
+    batch_stats) — federated collectives stay one tree op."""
+    model = get_model("resnet9")
+    variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 16, 16, 3)))
+    assert set(variables.keys()) == {"params"}
+
+
+def test_param_dtype_policy():
+    model = get_model("mnist-mlp")
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 28, 28, 1)))
+    for leaf in jax.tree.leaves(params):
+        assert leaf.dtype == jnp.float32
+
+
+def test_registry_errors_and_aliases():
+    with pytest.raises(ValueError):
+        get_model("nope")
+    assert "mnist-mlp" in list_models()
+    assert get_model("mlp").__class__.__name__ == "MLP"
+
+
+def test_resnet_depth_factory():
+    from p2pfl_tpu.models.resnet import CIFAR10ModelResNet
+
+    m = CIFAR10ModelResNet(depth=18)
+    assert m.stage_sizes == (2, 2, 2, 2)

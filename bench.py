@@ -1,0 +1,91 @@
+"""Benchmark: federated round wall-clock on the north-star workload.
+
+Metric: steady-state wall-clock per federated round for an 8-node
+FEMNIST-CNN federation (ring topology, FedAvg, 1 local epoch over
+750 samples/node, batch 32) on the available TPU device(s) — the
+BASELINE.json config "FEMNIST-CNN, 8 nodes, ring topology, FedAvg".
+
+Baseline: the reference cannot complete a federated round faster than
+its built-in pacing: WAIT_HEARTBEATS_CONVERGENCE = 10 s of mandatory
+sleep per learning start (participant.json.example:76, node.py:302-304)
+plus model gossip at GOSSIP_MODELS_FREC = 1 Hz with fan-out 2
+(participant.json.example:81-82) needing ≥ ceil(log2(8)) + 1 ≈ 4 ticks
+for 8-node diffusion, plus per-round aggregation waits — a floor of
+~15 s/round before any compute, independent of hardware. We use
+15 s/round as the (generous) baseline; ``vs_baseline`` is the speedup
+(baseline / measured).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+BASELINE_ROUND_S = 15.0  # reference pacing floor, see module docstring
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from p2pfl_tpu.config.schema import DataConfig
+    from p2pfl_tpu.datasets import FederatedDataset
+    from p2pfl_tpu.learning.learner import make_step_fns
+    from p2pfl_tpu.models import get_model
+    from p2pfl_tpu.parallel.federated import (
+        build_round_fn,
+        init_federation,
+        make_round_plan,
+    )
+    from p2pfl_tpu.parallel.transport import MeshTransport
+    from p2pfl_tpu.topology.topology import generate_topology
+
+    n = 8
+    ds = FederatedDataset.make(
+        DataConfig(dataset="femnist", samples_per_node=750, batch_size=32),
+        n,
+    )
+    x, y, smask, nsamp = ds.stacked()
+    model = get_model("femnist-cnn")
+    fns = make_step_fns(model, learning_rate=0.05, batch_size=32)
+    topo = generate_topology("ring", n)
+    plan = make_round_plan(topo, ["aggregator"] * n, "DFL")
+
+    tr = MeshTransport(n)
+    fed = tr.put_stacked(init_federation(fns, jnp.asarray(x[0, :1]), n))
+    args = [
+        tr.put_stacked(jnp.asarray(a))
+        for a in (x, y, smask, nsamp, plan.mix, plan.adopt, plan.trains)
+    ]
+    round_fn = tr.compile_round(build_round_fn(fns, epochs=1))
+
+    # warmup (compile) + steady-state timing; a device->host scalar
+    # fetch per round forces real synchronization (block_until_ready on
+    # donated buffers can return early on the experimental axon backend)
+    fed, m = round_fn(fed, *args)
+    float(jnp.sum(m["train_loss"]))
+    times = []
+    for _ in range(5):
+        t0 = time.monotonic()
+        fed, m = round_fn(fed, *args)
+        float(jnp.sum(m["train_loss"]))
+        times.append(time.monotonic() - t0)
+    round_s = float(np.median(times))
+
+    print(
+        json.dumps(
+            {
+                "metric": "femnist_cnn_8node_ring_round_wall_clock",
+                "value": round(round_s, 4),
+                "unit": "s/round",
+                "vs_baseline": round(BASELINE_ROUND_S / round_s, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -40,6 +40,7 @@ class ModelConfig:
     """model_args (node_start.py:46-85 model factory)."""
 
     model: str = "mlp"
+    objective: str = "classification"  # classification | autoencoder | ocsvm
     param_dtype: str = "float32"
     compute_dtype: str = "bfloat16"  # MXU-native
     kwargs: dict[str, Any] = dataclasses.field(default_factory=dict)

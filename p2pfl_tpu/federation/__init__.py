@@ -1,0 +1,26 @@
+"""Federation control plane: the host-side round state machine.
+
+The reference runs its control plane as a thread soup — heartbeater,
+gossiper, per-connection readers, lock-as-condition-variable idioms
+(SURVEY.md §5.2). Here it is an explicit, deterministic state machine:
+membership (heartbeats/eviction), SDFL leadership rotation, fault
+injection, and checkpointing advance round-by-round on the host, and
+each round hands fixed-shape arrays (mixing matrix, adopt vector,
+alive mask) to the jitted dataplane in p2pfl_tpu.parallel.
+"""
+
+from p2pfl_tpu.federation.events import Events, Observable, Observer
+from p2pfl_tpu.federation.membership import Membership
+from p2pfl_tpu.federation.checkpoint import load_checkpoint, save_checkpoint
+from p2pfl_tpu.federation.scenario import Scenario, ScenarioResult
+
+__all__ = [
+    "Events",
+    "Observable",
+    "Observer",
+    "Membership",
+    "load_checkpoint",
+    "save_checkpoint",
+    "Scenario",
+    "ScenarioResult",
+]

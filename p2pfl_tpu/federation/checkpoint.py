@@ -1,0 +1,69 @@
+"""Round-boundary checkpoint / resume.
+
+The reference has NO checkpointing (lightninglearner.py:190 disables
+it; a restarted node cannot rejoin — SURVEY.md §5.4). Here the whole
+federation state (stacked params + opt state + rngs + round + alive
+mask) serializes to one msgpack file at round boundaries, and a run
+can resume exactly where it stopped.
+"""
+
+from __future__ import annotations
+
+import pathlib
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import serialization as flax_ser
+
+from p2pfl_tpu.parallel.federated import FederatedState
+
+_SUFFIX = ".ckpt.msgpack"
+
+
+def checkpoint_path(directory: str | pathlib.Path, round_num: int) -> pathlib.Path:
+    return pathlib.Path(directory) / f"round_{round_num:05d}{_SUFFIX}"
+
+
+def save_checkpoint(directory: str | pathlib.Path, fed: FederatedState) -> pathlib.Path:
+    """Write the federation state; returns the file path."""
+    directory = pathlib.Path(directory)
+    directory.mkdir(parents=True, exist_ok=True)
+    host = jax.tree.map(np.asarray, fed)
+    # to_state_dict turns namedtuple opt states / dataclasses into plain
+    # nested dicts that msgpack can carry
+    blob = flax_ser.msgpack_serialize(flax_ser.to_state_dict(host))
+    path = checkpoint_path(directory, int(host.round))
+    path.write_bytes(blob)
+    return path
+
+
+def latest_checkpoint(directory: str | pathlib.Path) -> pathlib.Path | None:
+    directory = pathlib.Path(directory)
+    if not directory.is_dir():
+        return None
+    ckpts = sorted(directory.glob(f"round_*{_SUFFIX}"))
+    return ckpts[-1] if ckpts else None
+
+
+def load_checkpoint(path: str | pathlib.Path, template: FederatedState) -> FederatedState:
+    """Restore into the structure of ``template`` (shape/dtype checked
+    by flax's from_bytes-style restore against the template leaves)."""
+    obj = flax_ser.msgpack_restore(pathlib.Path(path).read_bytes())
+    try:
+        restored = flax_ser.from_state_dict(template, obj)
+    except Exception as e:
+        raise ValueError(f"checkpoint does not match federation: {e}") from e
+    # conform leaf dtypes and check shapes against the template
+    flat_t, treedef = jax.tree.flatten(template)
+    flat_r = jax.tree.leaves(restored)
+    conformed = []
+    for t, r in zip(flat_t, flat_r):
+        r = jnp.asarray(r)
+        if r.shape != t.shape:
+            raise ValueError(
+                f"checkpoint leaf shape {r.shape} != expected {t.shape}"
+            )
+        conformed.append(r.astype(t.dtype))
+    return jax.tree.unflatten(treedef, conformed)

@@ -1,0 +1,57 @@
+"""In-process event bus.
+
+Parity with fedstellar/utils/observer.py (Events/Observable/Observer,
+16 event types, synchronous fan-out :125-137), with the event set
+reduced to what survives the synchronous-dataplane redesign: transport
+events (BEAT/CONNECT...) that existed to glue threads together are
+replaced by round-lifecycle events the observability layer subscribes
+to.
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Any, Callable
+
+
+class Events(enum.Enum):
+    ROUND_STARTED = "round_started"
+    TRAIN_FINISHED = "train_finished"
+    AGGREGATION_FINISHED = "aggregation_finished"  # observer.py:34 analog
+    ROUND_FINISHED = "round_finished"
+    NODE_DIED = "node_died"  # heartbeat eviction (heartbeater.py:88-101)
+    NODE_RECOVERED = "node_recovered"
+    LEADERSHIP_TRANSFERRED = "leadership_transferred"  # node.py:676-686
+    LEARNING_FINISHED = "learning_finished"
+    METRICS_REPORTED = "metrics_reported"  # REPORT_STATUS analog
+    CHECKPOINT_SAVED = "checkpoint_saved"
+
+
+class Observer:
+    """Receives events. Parity with observer.py's Observer interface."""
+
+    def update(self, event: Events, payload: Any = None) -> None:
+        raise NotImplementedError
+
+
+class Observable:
+    """Synchronous fan-out to registered observers (observer.py:125-137).
+
+    Callables are accepted as observers too: ``obs(event, payload)``.
+    """
+
+    def __init__(self):
+        self._observers: list[Observer | Callable] = []
+
+    def add_observer(self, obs: Observer | Callable) -> None:
+        self._observers.append(obs)
+
+    def get_observers(self) -> list:
+        return list(self._observers)
+
+    def notify(self, event: Events, payload: Any = None) -> None:
+        for obs in self._observers:
+            if isinstance(obs, Observer):
+                obs.update(event, payload)
+            else:
+                obs(event, payload)

@@ -1,0 +1,83 @@
+"""Membership: heartbeats, timeouts, eviction — on a virtual clock.
+
+Parity with fedstellar/heartbeater.py (BEAT every HEARTBEAT_PERIOD=4 s,
+eviction after NODE_TIMEOUT=20 s of silence :88-101) re-designed for
+determinism: time is a virtual clock advanced by the round loop, so a
+"node died at round r" fault produces byte-identical runs. In DCN mode
+the same class runs on wall-clock time fed by real heartbeat receipts.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from p2pfl_tpu.config.schema import FaultEvent, ProtocolConfig
+from p2pfl_tpu.federation.events import Events, Observable
+
+
+class Membership(Observable):
+    """Tracks {node: last_seen}; derives the alive mask.
+
+    ``beat(i, t)`` = a heartbeat from node i at time t (heartbeater
+    add_node analog). ``advance_to(t)`` evicts nodes silent for longer
+    than ``node_timeout_s`` and fires NODE_DIED (clear_nodes analog).
+    Fault injection (FaultEvent crash/recover) simply stops/resumes a
+    node's heartbeats.
+    """
+
+    def __init__(self, n_nodes: int, protocol: ProtocolConfig | None = None,
+                 virtual: bool = True):
+        """``virtual=True`` (simulation): the clock synthesizes beats
+        for nodes whose ``beating`` flag is set, so liveness is fully
+        scripted by FaultEvents. ``virtual=False`` (DCN/real mode):
+        only explicit :meth:`beat` calls count, and a silent remote
+        node is evicted after the timeout."""
+        super().__init__()
+        self.protocol = protocol or ProtocolConfig()
+        self.n = n_nodes
+        self.virtual = virtual
+        self.last_seen = np.zeros(n_nodes, np.float64)
+        self.beating = np.ones(n_nodes, bool)  # currently emitting beats
+        self.alive = np.ones(n_nodes, bool)  # membership view
+        self.clock = 0.0
+
+    def beat(self, node: int, t: float | None = None) -> None:
+        t = self.clock if t is None else t
+        self.last_seen[node] = t
+        if not self.alive[node]:
+            self.alive[node] = True
+            self.notify(Events.NODE_RECOVERED, {"node": node, "t": t})
+
+    def apply_fault(self, fault: FaultEvent) -> None:
+        if fault.kind == "crash":
+            self.beating[fault.node] = False
+        elif fault.kind == "recover":
+            self.beating[fault.node] = True
+            self.beat(fault.node)
+        else:
+            raise ValueError(f"unknown fault kind {fault.kind!r}")
+
+    def advance_to(self, t: float) -> np.ndarray:
+        """Advance the virtual clock: beating nodes emit heartbeats at
+        heartbeat_period_s cadence; silent nodes past node_timeout_s
+        are evicted. Returns the alive mask."""
+        period = self.protocol.heartbeat_period_s
+        if self.virtual:
+            # synthesize the beats scripted nodes emitted in (clock, t];
+            # never move last_seen backwards past a real beat() call
+            for node in range(self.n):
+                if self.beating[node]:
+                    self.last_seen[node] = max(
+                        self.last_seen[node], (t // period) * period
+                    )
+        self.clock = t
+        timeout = self.protocol.node_timeout_s
+        for node in range(self.n):
+            if self.alive[node] and t - self.last_seen[node] > timeout:
+                self.alive[node] = False
+                self.notify(Events.NODE_DIED, {"node": node, "t": t})
+        return self.alive.copy()
+
+    def get_nodes(self) -> list[int]:
+        """Current members (heartbeater.get_nodes analog)."""
+        return [int(i) for i in np.flatnonzero(self.alive)]

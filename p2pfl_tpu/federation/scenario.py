@@ -1,0 +1,293 @@
+"""Scenario: build + run a whole federation.
+
+The successor of the reference's deploy-and-train path (Controller.
+load_configurations_and_start_nodes → N processes → Node.
+set_start_learning → per-node round loops, SURVEY.md §3.1-3.3),
+collapsed into one host object driving one jitted round program:
+
+    scenario = Scenario(ScenarioConfig(...))
+    result = scenario.run()
+
+Per round the host: (1) applies scheduled fault events and advances
+the virtual membership clock (heartbeat eviction), (2) rotates SDFL
+leadership among alive nodes, (3) recomputes the round plan if
+membership/leadership changed, (4) invokes the compiled SPMD round,
+(5) periodically evaluates, logs, and checkpoints. There are no grace
+sleeps — the reference's 30 s + 5 s/neighbor startup dead time
+(node_start.py:106,112) is replaced by compile time, which is cached
+after the first round.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from p2pfl_tpu.config.schema import ScenarioConfig
+from p2pfl_tpu.core.aggregators import get_aggregator
+from p2pfl_tpu.datasets import FederatedDataset
+from p2pfl_tpu.federation.checkpoint import (
+    latest_checkpoint,
+    load_checkpoint,
+    save_checkpoint,
+)
+from p2pfl_tpu.federation.events import Events, Observable
+from p2pfl_tpu.federation.membership import Membership
+from p2pfl_tpu.learning.learner import make_step_fns
+from p2pfl_tpu.models import get_model
+from p2pfl_tpu.parallel.federated import (
+    FederatedState,
+    build_eval_fn,
+    build_round_fn,
+    init_federation,
+    make_round_plan,
+)
+from p2pfl_tpu.parallel.transport import MeshTransport
+from p2pfl_tpu.topology.topology import generate_topology
+from p2pfl_tpu.utils.metrics import MetricsLogger
+from p2pfl_tpu.utils.telemetry import resource_snapshot
+
+
+@dataclasses.dataclass
+class ScenarioResult:
+    """What a run produces (the reference's equivalent is TB/W&B logs
+    plus the SQLite scenario row)."""
+
+    final_accuracy: float  # mean over alive nodes, central test set
+    per_node_accuracy: list[float]
+    rounds_run: int
+    round_times_s: list[float]
+    history: list[dict]  # metric records
+    rounds_to_target: int | None = None  # first round hitting target_acc
+
+
+class Scenario(Observable):
+    """Build and drive a federation from a ScenarioConfig."""
+
+    def __init__(self, config: ScenarioConfig, dataset: FederatedDataset | None = None):
+        super().__init__()
+        self.config = config
+        n = config.n_nodes
+        self.dataset = dataset or FederatedDataset.make(config.data, n)
+        self.model = get_model(config.model.model, **config.model.kwargs)
+        self.fns = make_step_fns(
+            self.model,
+            objective=config.model.objective,
+            optimizer=config.training.optimizer,
+            learning_rate=config.training.learning_rate,
+            momentum=config.training.momentum,
+            weight_decay=config.training.weight_decay,
+            batch_size=config.data.batch_size,
+        )
+        self.topology = generate_topology(
+            config.topology, n, **config.topology_kwargs
+        )
+        self.aggregator = get_aggregator(
+            config.aggregator, **config.aggregator_kwargs
+        )
+        self.roles = [nc.role for nc in config.nodes]
+        self.membership = Membership(n, config.protocol)
+        self.logger = MetricsLogger(config.log_dir, config.name)
+        self.transport = MeshTransport(n)
+        self.leader = next(
+            (i for i, nc in enumerate(config.nodes)
+             if nc.role in ("aggregator", "server")),
+            0,
+        )
+        self._rng = np.random.default_rng(config.seed)
+        self._faults_by_round: dict[int, list] = {}
+        for f in config.faults:
+            self._faults_by_round.setdefault(f.round, []).append(f)
+
+        # ---- device-side setup
+        x, y, smask, nsamp = self.dataset.stacked()
+        tr = self.transport
+        self._data_args = tuple(
+            tr.put_stacked(jnp.asarray(a)) for a in (x, y, smask, nsamp)
+        )
+        self._x_test = tr.put_replicated(jnp.asarray(self.dataset.x_test))
+        self._y_test = tr.put_replicated(jnp.asarray(self.dataset.y_test))
+        self._round_fn = tr.compile_round(
+            build_round_fn(self.fns, aggregator=self.aggregator,
+                           epochs=config.training.epochs_per_round)
+        )
+        self._eval_fn = tr.compile_eval(build_eval_fn(self.fns))
+        self.fed = tr.put_stacked(
+            init_federation(self.fns, jnp.asarray(x[0, :1]), n,
+                            seed=config.seed)
+        )
+        self._maybe_resume()
+        self._steps_per_round = (
+            max(x.shape[1] // config.data.batch_size, 1)
+            * config.training.epochs_per_round
+        )
+        # resumed runs continue the FL-aware global-step x-axis
+        self.global_step = (
+            int(np.asarray(self.fed.round)) * self._steps_per_round
+        )
+        self._plan_cache: dict[int, tuple] = {}
+
+    # ------------------------------------------------------------------
+    def _maybe_resume(self) -> None:
+        if not self.config.checkpoint_dir:
+            return
+        path = latest_checkpoint(self.config.checkpoint_dir)
+        if path is None:
+            return
+        self.fed = self.transport.put_stacked(load_checkpoint(path, self.fed))
+        # rebuild the membership view at the checkpointed round: replay
+        # past faults, restore the alive mask, and advance the virtual
+        # clock so dead nodes stay dead instead of being resurrected by
+        # the first synthesized heartbeat
+        start_round = int(np.asarray(self.fed.round))
+        for r in sorted(self._faults_by_round):
+            if r < start_round:
+                for fault in self._faults_by_round[r]:
+                    self.membership.apply_fault(fault)
+        period = self.membership.protocol.heartbeat_period_s
+        clock = start_round * period
+        self.membership.clock = clock
+        alive = np.asarray(self.fed.alive)
+        self.membership.alive = alive.copy()
+        self.membership.last_seen = np.where(
+            self.membership.beating, clock, -np.inf
+        )
+
+    def _advance_membership(self, round_num: int) -> np.ndarray:
+        for fault in self._faults_by_round.get(round_num, []):
+            self.membership.apply_fault(fault)
+        # one round advances the virtual clock by one heartbeat period —
+        # eviction after node_timeout_s therefore takes
+        # ceil(timeout/period) rounds of silence, like the reference's
+        # 20 s timeout at 4 s beats
+        t = self.membership.clock + self.membership.protocol.heartbeat_period_s
+        return self.membership.advance_to(t)
+
+    def _rotate_leader(self, alive: np.ndarray) -> None:
+        if self.config.federation == "SDFL":
+            candidates = [
+                i for i in np.flatnonzero(alive)
+                if self.roles[i] in ("aggregator", "trainer")
+            ]
+            if candidates:
+                new = int(self._rng.choice(candidates))
+                if new != self.leader:
+                    self.notify(Events.LEADERSHIP_TRANSFERRED,
+                                {"from": self.leader, "to": new})
+                self.leader = new
+        elif not alive[self.leader] and self.config.federation == "CFL":
+            # dead server: fail over to the lowest-index alive node
+            alive_idx = np.flatnonzero(alive)
+            if len(alive_idx):
+                self.leader = int(alive_idx[0])
+
+    def _plan_args(self):
+        """Device arrays for the current round plan. Liveness is folded
+        in on-device from ``fed.alive``, so the plan depends only on the
+        leader — cache per leader to avoid per-round host→device
+        transfers."""
+        if self.leader not in self._plan_cache:
+            plan = make_round_plan(
+                self.topology, self.roles, self.config.federation, self.leader
+            )
+            tr = self.transport
+            self._plan_cache[self.leader] = (
+                tr.put_stacked(jnp.asarray(plan.mix)),
+                tr.put_stacked(jnp.asarray(plan.adopt)),
+                tr.put_stacked(jnp.asarray(plan.trains)),
+            )
+        return self._plan_cache[self.leader]
+
+    def evaluate(self) -> dict[str, Any]:
+        metrics = self._eval_fn(self.fed, self._x_test, self._y_test)
+        acc = np.asarray(metrics["accuracy"], np.float64)
+        loss = np.asarray(metrics["loss"], np.float64)
+        alive = np.asarray(self.fed.alive)
+        mean_acc = float(acc[alive].mean()) if alive.any() else 0.0
+        return {
+            "per_node_accuracy": [float(a) for a in acc],
+            "per_node_loss": [float(l) for l in loss],
+            "mean_accuracy": mean_acc,
+            "min_accuracy": float(acc[alive].min()) if alive.any() else 0.0,
+        }
+
+    def run(self, rounds: int | None = None,
+            target_accuracy: float | None = None) -> ScenarioResult:
+        cfg = self.config
+        rounds = rounds if rounds is not None else cfg.training.rounds
+        round_times: list[float] = []
+        rounds_to_target = None
+        ev = None
+        ev_round = -1  # round the last evaluation reflects
+        start_round = int(np.asarray(self.fed.round))
+        for r in range(start_round, start_round + rounds):
+            t0 = time.monotonic()
+            self.notify(Events.ROUND_STARTED, {"round": r})
+            alive = self._advance_membership(r)
+            self._rotate_leader(alive)
+            self.fed = self.fed.replace(
+                alive=self.transport.put_stacked(jnp.asarray(alive))
+            )
+            self.fed, metrics = self._round_fn(
+                self.fed, *self._data_args, *self._plan_args()
+            )
+            jax.block_until_ready(self.fed.states.params)
+            self.notify(Events.AGGREGATION_FINISHED, {"round": r})
+            dt = time.monotonic() - t0
+            round_times.append(dt)
+            self.global_step += self._steps_per_round
+
+            train_loss = np.asarray(metrics["train_loss"], np.float64)
+            for i in range(cfg.n_nodes):
+                self.logger.log_metrics(
+                    {"Train/loss": float(train_loss[i]),
+                     "Train/round_time_s": dt},
+                    step=self.global_step, round=r, node=i,
+                )
+            if cfg.training.eval_every and (r + 1) % cfg.training.eval_every == 0:
+                ev = self.evaluate()
+                ev_round = r
+                for i, (a, l) in enumerate(
+                    zip(ev["per_node_accuracy"], ev["per_node_loss"])
+                ):
+                    self.logger.log_metrics(
+                        {"Test/accuracy": a, "Test/loss": l},
+                        step=self.global_step, round=r, node=i,
+                    )
+                self.logger.log_metrics(
+                    {"Test/mean_accuracy": ev["mean_accuracy"],
+                     "Test/min_accuracy": ev["min_accuracy"]},
+                    step=self.global_step, round=r,
+                )
+                if (target_accuracy is not None and rounds_to_target is None
+                        and ev["mean_accuracy"] >= target_accuracy):
+                    rounds_to_target = r + 1
+            self.logger.log_metrics(resource_snapshot(),
+                                    step=self.global_step, round=r)
+            self.logger.round_marker(r, self.global_step)
+            if cfg.checkpoint_every and (r + 1) % cfg.checkpoint_every == 0:
+                if cfg.checkpoint_dir:
+                    path = save_checkpoint(cfg.checkpoint_dir, self.fed)
+                    self.notify(Events.CHECKPOINT_SAVED, {"path": str(path)})
+            self.notify(Events.ROUND_FINISHED, {"round": r, "time_s": dt})
+
+        last_round = start_round + rounds - 1
+        if ev is None or ev_round != last_round:  # don't report stale eval
+            ev = self.evaluate()
+        self.notify(Events.LEARNING_FINISHED, {})
+        return ScenarioResult(
+            final_accuracy=ev["mean_accuracy"],
+            per_node_accuracy=ev["per_node_accuracy"],
+            rounds_run=rounds,
+            round_times_s=round_times,
+            history=self.logger.history,
+            rounds_to_target=rounds_to_target,
+        )
+
+    def close(self) -> None:
+        self.logger.close()

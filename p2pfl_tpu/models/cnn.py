@@ -45,14 +45,14 @@ class SmallCNN(nn.Module):
 
 
 @register_model("mnist-cnn", "cnn", "mnistmodelcnn")
-def MNISTModelCNN(num_classes: int = 10, **kw) -> SmallCNN:
-    return SmallCNN(channels=(32, 64), kernel=3, hidden=512,
+def MNISTModelCNN(num_classes: int = 10, hidden: int = 512, **kw) -> SmallCNN:
+    return SmallCNN(channels=(32, 64), kernel=3, hidden=hidden,
                     num_classes=num_classes, **kw)
 
 
 @register_model("femnist-cnn", "femnistmodelcnn")
-def FEMNISTModelCNN(num_classes: int = 62, **kw) -> SmallCNN:
+def FEMNISTModelCNN(num_classes: int = 62, hidden: int = 2048, **kw) -> SmallCNN:
     """The LEAF FEMNIST CNN shape — the north-star workload
     (BASELINE.json: 64-node FEMNIST-CNN federation)."""
-    return SmallCNN(channels=(32, 64), kernel=5, hidden=2048,
+    return SmallCNN(channels=(32, 64), kernel=5, hidden=hidden,
                     num_classes=num_classes, **kw)

@@ -1,0 +1,6 @@
+"""Cross-cutting utilities: metrics logging, telemetry, rendering."""
+
+from p2pfl_tpu.utils.metrics import MetricsLogger
+from p2pfl_tpu.utils.telemetry import resource_snapshot
+
+__all__ = ["MetricsLogger", "resource_snapshot"]

@@ -1,0 +1,87 @@
+"""FL-aware metrics logging.
+
+Parity with the reference's richest subsystem (SURVEY.md §5.5): the
+forked TensorBoard/W&B loggers whose x-axis concatenates per-round
+trainer steps via an accumulated global step
+(statisticslogger.py:131-153, lightninglearner.py:162-165), the CSV
+option (node.py:122-125), and round markers (node.py:642).
+
+Backends here: JSONL (machine-readable event stream) + per-node CSV.
+TensorBoard is omitted deliberately — the JSONL stream carries the
+same (step, round, metric) triples and has no service dependency.
+"""
+
+from __future__ import annotations
+
+import csv
+import json
+import pathlib
+import time
+from typing import Any
+
+
+class MetricsLogger:
+    """Writes scenario-level JSONL and per-node CSV metric streams.
+
+    Every record carries ``step`` (FL-aware global step: local steps
+    accumulated across rounds) and ``round``. ``node=None`` means a
+    federation-level metric (e.g. mean accuracy).
+    """
+
+    def __init__(self, log_dir: str | pathlib.Path | None, name: str = "scenario"):
+        self.enabled = log_dir is not None
+        self.name = name
+        self._csv_files: dict[int, Any] = {}
+        self._csv_writers: dict[int, Any] = {}
+        self.history: list[dict] = []  # in-memory view for tests/benchmarks
+        if self.enabled:
+            self.dir = pathlib.Path(log_dir) / name
+            self.dir.mkdir(parents=True, exist_ok=True)
+            self._jsonl = open(self.dir / "metrics.jsonl", "a", buffering=1)
+        else:
+            self.dir = None
+            self._jsonl = None
+
+    def log_metrics(self, metrics: dict[str, float], step: int = 0,
+                    round: int = 0, node: int | None = None) -> None:
+        rec = {
+            "ts": time.time(),
+            "step": int(step),
+            "round": int(round),
+            "node": node,
+            **{k: float(v) for k, v in metrics.items()},
+        }
+        self.history.append(rec)
+        if not self.enabled:
+            return
+        self._jsonl.write(json.dumps(rec) + "\n")
+        if node is not None:
+            self._node_csv(node, rec)
+
+    def _node_csv(self, node: int, rec: dict) -> None:
+        # long format (ts, step, round, metric, value): metric sets vary
+        # between train and eval records, and a wide CSV would freeze its
+        # columns at the first row
+        if node not in self._csv_writers:
+            f = open(self.dir / f"node_{node}.csv", "a", newline="",
+                     buffering=1)
+            w = csv.writer(f)
+            if f.tell() == 0:
+                w.writerow(["ts", "step", "round", "metric", "value"])
+            self._csv_files[node] = f
+            self._csv_writers[node] = w
+        w = self._csv_writers[node]
+        for key, val in rec.items():
+            if key in ("ts", "step", "round", "node"):
+                continue
+            w.writerow([rec["ts"], rec["step"], rec["round"], key, val])
+
+    def round_marker(self, round: int, step: int) -> None:
+        """Round-boundary marker (node.py:642 analog)."""
+        self.log_metrics({"round_boundary": 1.0}, step=step, round=round)
+
+    def close(self) -> None:
+        if self._jsonl:
+            self._jsonl.close()
+        for f in self._csv_files.values():
+            f.close()

@@ -1,0 +1,46 @@
+"""Host + device resource telemetry.
+
+Parity with the reference's psutil/pynvml loop (node.py:939-997,
+logged as ``Resources/*`` each report cycle), with TPU HBM stats from
+``jax.local_devices()[...].memory_stats()`` replacing the NVML GPU
+query.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+
+
+def resource_snapshot() -> dict[str, float]:
+    """One sample of CPU/RAM/disk/net + per-device HBM usage."""
+    out: dict[str, float] = {}
+    try:
+        import psutil
+
+        out["Resources/cpu_percent"] = psutil.cpu_percent(interval=None)
+        vm = psutil.virtual_memory()
+        out["Resources/ram_percent"] = vm.percent
+        out["Resources/ram_used_gb"] = vm.used / 2**30
+        du = psutil.disk_usage("/")
+        out["Resources/disk_percent"] = du.percent
+        net = psutil.net_io_counters()
+        out["Resources/net_sent_mb"] = net.bytes_sent / 2**20
+        out["Resources/net_recv_mb"] = net.bytes_recv / 2**20
+    except Exception:  # psutil optional — never break a round over telemetry
+        pass
+    for i, dev in enumerate(jax.local_devices()):
+        try:
+            stats: dict[str, Any] = dev.memory_stats() or {}
+            if "bytes_in_use" in stats:
+                out[f"Resources/device{i}_hbm_used_mb"] = (
+                    stats["bytes_in_use"] / 2**20
+                )
+            if "bytes_limit" in stats:
+                out[f"Resources/device{i}_hbm_limit_mb"] = (
+                    stats["bytes_limit"] / 2**20
+                )
+        except Exception:
+            continue
+    return out

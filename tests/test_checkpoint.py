@@ -1,0 +1,78 @@
+"""Checkpoint/resume — capability the reference lacks (SURVEY.md §5.4)."""
+
+import numpy as np
+import pytest
+
+from p2pfl_tpu.config.schema import DataConfig, ScenarioConfig, TrainingConfig
+from p2pfl_tpu.federation import Scenario, load_checkpoint, save_checkpoint
+from p2pfl_tpu.federation.checkpoint import latest_checkpoint
+
+
+def _cfg(tmp_path, rounds=2):
+    return ScenarioConfig(
+        name="ckpt",
+        n_nodes=2,
+        data=DataConfig(dataset="mnist", samples_per_node=200),
+        training=TrainingConfig(rounds=rounds, epochs_per_round=1,
+                                learning_rate=0.05),
+        checkpoint_dir=str(tmp_path),
+        checkpoint_every=1,
+    )
+
+
+def test_save_resume_exact(tmp_path):
+    s1 = Scenario(_cfg(tmp_path))
+    s1.run()
+    ckpt = latest_checkpoint(tmp_path)
+    assert ckpt is not None and "round_00002" in ckpt.name
+
+    # a fresh Scenario resumes from the latest checkpoint
+    s2 = Scenario(_cfg(tmp_path))
+    assert int(np.asarray(s2.fed.round)) == 2
+    import jax
+
+    for a, b in zip(jax.tree.leaves(s1.fed.states.params),
+                    jax.tree.leaves(s2.fed.states.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    # continuing the run starts at round 2
+    res = s2.run(rounds=1)
+    assert int(np.asarray(s2.fed.round)) == 3
+
+
+def test_resume_keeps_dead_nodes_dead(tmp_path):
+    """A node dead at checkpoint time must not resurrect on resume."""
+    from p2pfl_tpu.config.schema import FaultEvent, ProtocolConfig
+
+    cfg = ScenarioConfig(
+        name="ckpt-fault",
+        n_nodes=2,
+        data=DataConfig(dataset="mnist", samples_per_node=200),
+        training=TrainingConfig(rounds=2, epochs_per_round=1,
+                                learning_rate=0.05),
+        protocol=ProtocolConfig(node_timeout_s=3.0),
+        faults=[FaultEvent(node=1, round=0, kind="crash")],
+        checkpoint_dir=str(tmp_path),
+        checkpoint_every=1,
+    )
+    s1 = Scenario(cfg)
+    s1.run()
+    assert not np.asarray(s1.fed.alive)[1]
+
+    s2 = Scenario(cfg)  # resumes from round 2
+    assert not np.asarray(s2.fed.alive)[1]
+    s2.run(rounds=1)
+    assert not np.asarray(s2.fed.alive)[1], "dead node resurrected on resume"
+
+
+def test_load_rejects_mismatched_shape(tmp_path):
+    s = Scenario(_cfg(tmp_path, rounds=1))
+    path = save_checkpoint(tmp_path / "x", s.fed)
+    other = ScenarioConfig(
+        name="other", n_nodes=4,
+        data=DataConfig(dataset="mnist", samples_per_node=100),
+        training=TrainingConfig(rounds=1, epochs_per_round=1),
+    )
+    s4 = Scenario(other)
+    with pytest.raises(ValueError):
+        load_checkpoint(path, s4.fed)

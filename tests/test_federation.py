@@ -1,0 +1,152 @@
+"""Control plane: membership, scenario runner, faults, SDFL, events."""
+
+import json
+
+import numpy as np
+import pytest
+
+from p2pfl_tpu.config.schema import (
+    DataConfig,
+    FaultEvent,
+    ModelConfig,
+    ProtocolConfig,
+    ScenarioConfig,
+    TrainingConfig,
+)
+from p2pfl_tpu.federation import Events, Membership, Scenario
+
+
+def _cfg(**kw):
+    base = dict(
+        name="t",
+        n_nodes=4,
+        data=DataConfig(dataset="mnist", samples_per_node=200),
+        model=ModelConfig(model="mnist-mlp"),
+        training=TrainingConfig(rounds=2, epochs_per_round=1,
+                                learning_rate=0.05),
+    )
+    base.update(kw)
+    return ScenarioConfig(**base)
+
+
+class TestMembership:
+    def test_eviction_after_timeout(self):
+        proto = ProtocolConfig(heartbeat_period_s=4.0, node_timeout_s=20.0)
+        m = Membership(4, proto)
+        events = []
+        m.add_observer(lambda e, p: events.append((e, p)))
+        m.apply_fault(FaultEvent(node=2, kind="crash"))
+        # silence < timeout: still alive; > timeout: evicted
+        for k in range(1, 10):
+            alive = m.advance_to(k * 4.0)
+            if k * 4.0 - 0.0 <= 20.0:
+                assert alive[2], f"evicted too early at t={k * 4.0}"
+            else:
+                break
+        assert not alive[2]
+        assert (Events.NODE_DIED, {"node": 2, "t": m.clock}) in events
+
+    def test_recovery(self):
+        m = Membership(2)
+        m.apply_fault(FaultEvent(node=1, kind="crash"))
+        m.advance_to(100.0)
+        assert not m.alive[1]
+        m.apply_fault(FaultEvent(node=1, kind="recover"))
+        assert m.alive[1]
+        assert m.get_nodes() == [0, 1]
+
+    def test_real_mode_evicts_silent_node(self):
+        """virtual=False (DCN mode): only explicit beats keep a node
+        alive — a silently-dead remote is evicted after the timeout."""
+        proto = ProtocolConfig(heartbeat_period_s=4.0, node_timeout_s=20.0)
+        m = Membership(2, proto, virtual=False)
+        for t in (4.0, 8.0, 12.0):
+            m.beat(0, t)
+            m.beat(1, t)
+            m.advance_to(t)
+        for t in (16.0, 20.0, 24.0, 28.0, 32.0, 36.0):
+            m.beat(0, t)  # node 1 went silent at t=12
+            alive = m.advance_to(t)
+        assert alive[0] and not alive[1]
+
+    def test_real_mode_beat_not_rewound(self):
+        proto = ProtocolConfig(heartbeat_period_s=4.0, node_timeout_s=2.0)
+        m = Membership(1, proto, virtual=False)
+        m.beat(0, 11.0)
+        assert m.advance_to(11.5)[0]  # a 0.5s-old beat must not evict
+
+
+class TestScenario:
+    def test_dfl_run_learns(self):
+        s = Scenario(_cfg())
+        res = s.run()
+        assert res.final_accuracy > 0.5
+        assert res.rounds_run == 2
+        assert len(res.round_times_s) == 2
+        assert len(res.per_node_accuracy) == 4
+        assert any("Test/accuracy" in r for r in res.history)
+
+    def test_fault_injection_node_dies_run_completes(self):
+        # crash at round 0; with default 4s beats/20s timeout the node is
+        # evicted ~5 rounds later — use a fast protocol so it dies at once
+        cfg = _cfg(
+            training=TrainingConfig(rounds=3, epochs_per_round=1,
+                                    learning_rate=0.05),
+            protocol=ProtocolConfig(heartbeat_period_s=4.0,
+                                    node_timeout_s=3.0),
+            faults=[FaultEvent(node=3, round=1, kind="crash")],
+        )
+        s = Scenario(cfg)
+        died = []
+        s.membership.add_observer(
+            lambda e, p: died.append(p["node"]) if e is Events.NODE_DIED else None
+        )
+        res = s.run()
+        assert died == [3]
+        assert not np.asarray(s.fed.alive)[3]
+        # survivors still reach accuracy
+        alive_acc = [a for i, a in enumerate(res.per_node_accuracy) if i != 3]
+        assert min(alive_acc) > 0.5
+
+    def test_sdfl_rotates_leadership(self):
+        cfg = _cfg(federation="SDFL",
+                   training=TrainingConfig(rounds=4, epochs_per_round=1,
+                                           learning_rate=0.05))
+        s = Scenario(cfg)
+        transfers = []
+        s.add_observer(
+            lambda e, p: transfers.append(p)
+            if e is Events.LEADERSHIP_TRANSFERRED else None
+        )
+        s.run()
+        assert transfers, "leadership never rotated in 4 SDFL rounds"
+
+    def test_cfl_server_failover(self):
+        cfg = _cfg(
+            federation="CFL", topology="star",
+            training=TrainingConfig(rounds=3, epochs_per_round=1,
+                                    learning_rate=0.05),
+            protocol=ProtocolConfig(node_timeout_s=3.0),
+            faults=[FaultEvent(node=0, round=1, kind="crash")],
+        )
+        s = Scenario(cfg)
+        s.run()
+        assert s.leader != 0, "dead CFL server was not failed over"
+
+
+def test_cli_end_to_end(tmp_path, capsys):
+    from p2pfl_tpu.run import main
+
+    rc = main([
+        "--nodes", "2", "--rounds", "1", "--epochs", "1",
+        "--samples-per-node", "200", "--lr", "0.05",
+        "--log-dir", str(tmp_path),
+    ])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["n_nodes"] == 2
+    assert 0.0 <= out["final_accuracy"] <= 1.0
+    assert (tmp_path / "mnist-mnist-mlp-dfl" / "metrics.jsonl").exists()
+    # node CSVs are long-format and include eval metrics
+    csv_text = (tmp_path / "mnist-mnist-mlp-dfl" / "node_0.csv").read_text()
+    assert "Test/accuracy" in csv_text and "Train/loss" in csv_text

@@ -64,6 +64,7 @@ class ScenarioResult:
     round_times_s: list[float]
     history: list[dict]  # metric records
     rounds_to_target: int | None = None  # first round hitting target_acc
+    min_accuracy: float = 0.0  # min over ALIVE nodes (dead excluded)
 
 
 class Scenario(Observable):
@@ -140,23 +141,13 @@ class Scenario(Observable):
         if path is None:
             return
         self.fed = self.transport.put_stacked(load_checkpoint(path, self.fed))
-        # rebuild the membership view at the checkpointed round: replay
-        # past faults, restore the alive mask, and advance the virtual
-        # clock so dead nodes stay dead instead of being resurrected by
-        # the first synthesized heartbeat
+        # replay the membership trajectory through the checkpointed
+        # rounds — identical fault application and clock advancement to
+        # the uninterrupted run, so eviction timing (and therefore every
+        # subsequent mix weight) matches exactly
         start_round = int(np.asarray(self.fed.round))
-        for r in sorted(self._faults_by_round):
-            if r < start_round:
-                for fault in self._faults_by_round[r]:
-                    self.membership.apply_fault(fault)
-        period = self.membership.protocol.heartbeat_period_s
-        clock = start_round * period
-        self.membership.clock = clock
-        alive = np.asarray(self.fed.alive)
-        self.membership.alive = alive.copy()
-        self.membership.last_seen = np.where(
-            self.membership.beating, clock, -np.inf
-        )
+        for r in range(start_round):
+            self._advance_membership(r)
 
     def _advance_membership(self, round_num: int) -> np.ndarray:
         for fault in self._faults_by_round.get(round_num, []):
@@ -279,6 +270,9 @@ class Scenario(Observable):
         last_round = start_round + rounds - 1
         if ev is None or ev_round != last_round:  # don't report stale eval
             ev = self.evaluate()
+            if (target_accuracy is not None and rounds_to_target is None
+                    and ev["mean_accuracy"] >= target_accuracy):
+                rounds_to_target = last_round + 1
         self.notify(Events.LEARNING_FINISHED, {})
         return ScenarioResult(
             final_accuracy=ev["mean_accuracy"],
@@ -287,6 +281,7 @@ class Scenario(Observable):
             round_times_s=round_times,
             history=self.logger.history,
             rounds_to_target=rounds_to_target,
+            min_accuracy=ev["min_accuracy"],
         )
 
     def close(self) -> None:

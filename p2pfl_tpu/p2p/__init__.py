@@ -1,0 +1,35 @@
+"""Async P2P runtime: the DCN / multi-host path.
+
+The in-process mesh transport (p2pfl_tpu.parallel) covers federations
+that fit one host's devices; this package is the successor of the
+reference's L1 socket runtime (fedstellar/base_node.py,
+node_connection.py, communication_protocol.py, gossiper.py,
+heartbeater.py) for federations spanning hosts/pods:
+
+- ``protocol``: length-prefixed msgpack frames over TCP — replaces the
+  reference's hand-rolled text grammar with 2 KB padded fragments and
+  pickle payloads (communication_protocol.py:37-134, 737-769).
+- ``session``: the aggregation session — contributor-set bookkeeping,
+  partial aggregation for peers, timeout-bounded completion
+  (learning/aggregators/aggregator.py:106-229 parity).
+- ``node``: an asyncio node — listener, per-peer streams, gossip,
+  heartbeats, and the round state machine — replacing the reference's
+  thread-per-connection design with a single event loop.
+
+Within a host, each node still trains through the same jitted StepFns;
+across hosts only weights move, so the TPU compute path is identical
+in both transports.
+"""
+
+from p2pfl_tpu.p2p.protocol import Message, MsgType, read_message, write_message
+from p2pfl_tpu.p2p.session import AggregationSession
+from p2pfl_tpu.p2p.node import P2PNode
+
+__all__ = [
+    "Message",
+    "MsgType",
+    "read_message",
+    "write_message",
+    "AggregationSession",
+    "P2PNode",
+]

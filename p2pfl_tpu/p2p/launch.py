@@ -1,0 +1,168 @@
+"""Multi-process federation launcher — the "real mode" controller.
+
+Parity with the reference's deployment path (controller.py:456-485
+start_nodes_cmd: one OS process per participant reading its stamped
+JSON; node_start.py:28-120 per-process entry), minus the fixed 30 s +
+5 s/neighbor sleeps: nodes retry-connect until their neighbors' ports
+listen.
+
+Usage (also what ``python -m p2pfl_tpu.p2p.launch scenario.json``
+does): the parent stamps per-node JSON configs with assigned ports,
+spawns N ``node_main`` processes, waits, and aggregates their result
+lines. Each process trains with the same JaxLearner; on a multi-host
+deployment you run ``node_main`` yourself on each host with the same
+scenario file and per-host node indices.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import pathlib
+import socket
+import subprocess
+import sys
+import time
+
+from p2pfl_tpu.config.schema import ScenarioConfig
+from p2pfl_tpu.core.aggregators import get_aggregator
+from p2pfl_tpu.datasets import FederatedDataset
+from p2pfl_tpu.learning import JaxLearner
+from p2pfl_tpu.models import get_model
+from p2pfl_tpu.p2p.node import P2PNode
+from p2pfl_tpu.topology.topology import generate_topology
+
+
+def _free_ports(n: int) -> list[int]:
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+async def _run_node(cfg: ScenarioConfig, idx: int, ports: list[int]) -> dict:
+    """One node's full lifecycle (node_start.py main analog)."""
+    n = cfg.n_nodes
+    data = FederatedDataset.make(cfg.data, n)  # deterministic: same shards
+    learner = JaxLearner(
+        model=get_model(cfg.model.model, **cfg.model.kwargs),
+        data=data.nodes[idx],
+        objective=cfg.model.objective,
+        optimizer=cfg.training.optimizer,
+        learning_rate=cfg.training.learning_rate,
+        momentum=cfg.training.momentum,
+        weight_decay=cfg.training.weight_decay,
+        batch_size=cfg.data.batch_size,
+        seed=cfg.seed,
+    )
+    node = P2PNode(
+        idx,
+        learner,
+        port=ports[idx],
+        role=cfg.nodes[idx].role,
+        n_nodes=n,
+        aggregator=get_aggregator(cfg.aggregator, **cfg.aggregator_kwargs),
+        protocol=cfg.protocol,
+        federation=cfg.federation,
+        seed=cfg.seed,
+    )
+    await node.start()
+    topo = generate_topology(cfg.topology, n, **cfg.topology_kwargs)
+    # connect to higher-index neighbors; lower-index ones dial us.
+    # retry until the peer's listener is up (replaces node_start.py:106's
+    # fixed 30 s grace sleep)
+    for j in topo.neighbors(idx):
+        if j < idx:
+            continue
+        deadline = time.monotonic() + 60
+        while True:
+            try:
+                await node.connect_to("127.0.0.1", ports[j])
+                break
+            except OSError:
+                if time.monotonic() > deadline:
+                    raise
+                await asyncio.sleep(0.1)
+    # wait until every neighbor connection exists (either direction)
+    want = set(topo.neighbors(idx))
+    deadline = time.monotonic() + 60
+    while not want <= set(node.peers) and time.monotonic() < deadline:
+        await asyncio.sleep(0.1)
+    if cfg.nodes[idx].start:
+        learner.init()
+        node.set_start_learning(cfg.training.rounds,
+                                cfg.training.epochs_per_round)
+    await asyncio.wait_for(node.finished.wait(), timeout=600)
+    metrics = learner.evaluate()
+    await node.stop()
+    return {"node": idx, "round": node.round, **metrics}
+
+
+def node_main(config_path: str, idx: int, ports: list[int]) -> None:
+    cfg = ScenarioConfig.load(config_path)
+    result = asyncio.run(_run_node(cfg, idx, ports))
+    print("P2PFL_RESULT " + json.dumps(result), flush=True)
+
+
+def launch(cfg: ScenarioConfig, config_path: str | pathlib.Path,
+           platform: str | None = None) -> list[dict]:
+    """Spawn one OS process per node; collect their results.
+
+    ``platform="cpu"`` forces the children onto the CPU backend — N
+    processes cannot share one TPU chip, so multi-process mode on a
+    single-chip host runs compute on CPU (on a pod each host pins its
+    own chips).
+    """
+    ports = _free_ports(cfg.n_nodes)
+    procs = []
+    for i in range(cfg.n_nodes):
+        cmd = [sys.executable, "-m", "p2pfl_tpu.p2p.launch",
+               str(config_path), "--node", str(i),
+               "--ports", ",".join(map(str, ports))]
+        if platform:
+            cmd += ["--platform", platform]
+        procs.append(
+            subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                             stderr=subprocess.STDOUT, text=True)
+        )
+    results = []
+    for p in procs:
+        out, _ = p.communicate(timeout=900)
+        for line in out.splitlines():
+            if line.startswith("P2PFL_RESULT "):
+                results.append(json.loads(line[len("P2PFL_RESULT "):]))
+    return results
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(prog="p2pfl_tpu.p2p.launch")
+    ap.add_argument("config")
+    ap.add_argument("--node", type=int, default=None,
+                    help="run a single node in-process (child mode)")
+    ap.add_argument("--ports", default=None,
+                    help="comma-separated port per node (child mode)")
+    ap.add_argument("--platform", default=None,
+                    help="force a JAX platform (e.g. cpu) in children")
+    args = ap.parse_args(argv)
+    if args.platform:
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
+    if args.node is not None:
+        node_main(args.config, args.node,
+                  [int(p) for p in args.ports.split(",")])
+        return 0
+    cfg = ScenarioConfig.load(args.config)
+    results = launch(cfg, args.config, platform=args.platform)
+    print(json.dumps({"nodes": results}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

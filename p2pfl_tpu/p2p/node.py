@@ -1,0 +1,471 @@
+"""P2PNode: an asyncio federated node over TCP.
+
+Role/behavior parity with the reference's Node (fedstellar/node.py) and
+BaseNode (base_node.py), with the thread-per-connection design replaced
+by one event loop per node:
+
+- listener + per-peer streams + CONNECT handshake
+  (base_node.py:197-278);
+- heartbeats feeding wall-clock membership (heartbeater.py);
+- gossip flooding of control messages with at-most-once dedup
+  (gossiper.py, communication_protocol.py:146-160);
+- the round state machine with role branches (node.py:427-524):
+  AGGREGATOR/SERVER train + aggregate + gossip partial aggregates;
+  TRAINER trains, ships its model, adopts the aggregate; IDLE only
+  adopts; per-peer progress tracking (MODELS_AGGREGATED /
+  MODELS_READY / MODEL_INITIALIZED) gates who still needs gossip
+  (node.py:695-724);
+- initial model diffusion from the starter node (node.py:299);
+- SDFL leadership transfer (node.py:676-686).
+
+Local training runs through any NodeLearner (JaxLearner — jitted on
+the host's TPU); only weight payloads cross the network, in the safe
+envelope from p2pfl_tpu.core.serialize.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import dataclasses
+import logging
+import random
+from typing import Any
+
+from p2pfl_tpu.config.schema import ProtocolConfig
+from p2pfl_tpu.core.aggregators import Aggregator
+from p2pfl_tpu.core.serialize import decode_parameters, encode_parameters
+from p2pfl_tpu.federation.membership import Membership
+from p2pfl_tpu.p2p.protocol import (
+    GOSSIPED,
+    DedupRing,
+    Message,
+    MsgType,
+    read_message,
+    write_message,
+)
+
+log = logging.getLogger("p2pfl_tpu.p2p")
+
+
+@dataclasses.dataclass
+class PeerState:
+    """Per-peer round-progress view (node_connection.py:275-335)."""
+
+    idx: int
+    writer: asyncio.StreamWriter
+    reader_task: asyncio.Task | None = None
+    models_aggregated: set[int] = dataclasses.field(default_factory=set)
+    initialized: bool = False
+    ready_round: int = -1
+
+
+class P2PNode:
+    """One federated node. Wire up a learner, start, connect, learn."""
+
+    def __init__(
+        self,
+        idx: int,
+        learner,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        role: str = "aggregator",
+        n_nodes: int = 2,
+        aggregator: Aggregator | None = None,
+        protocol: ProtocolConfig | None = None,
+        start_learning: bool = False,
+        gossip_period_s: float = 0.05,
+        federation: str = "DFL",
+        seed: int = 0,
+    ):
+        from p2pfl_tpu.p2p.session import AggregationSession
+
+        self.idx = idx
+        self.learner = learner
+        self.host = host
+        self.port = port
+        self.role = role
+        self.n_nodes = n_nodes
+        self.protocol = protocol or ProtocolConfig()
+        self.start_learning_flag = start_learning
+        self.gossip_period_s = gossip_period_s
+        self.federation = federation
+        self._rng = random.Random(seed * 7919 + idx)
+        self.session = AggregationSession(
+            aggregator, timeout_s=self.protocol.aggregation_timeout_s
+        )
+        self.membership = Membership(n_nodes, self.protocol, virtual=False)
+        self.peers: dict[int, PeerState] = {}
+        self.peer_roles: dict[int, str] = {}
+        self.dedup = DedupRing()
+        self.round = 0
+        self.total_rounds = 0
+        self.epochs = 1
+        self.initialized = False
+        self.learning = False
+        self.leader: int | None = None
+        self._server: asyncio.Server | None = None
+        self._tasks: list[asyncio.Task] = []
+        self._learn_task: asyncio.Task | None = None
+        self.finished = asyncio.Event()
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(
+            self._on_connection, self.host, self.port
+        )
+        self.port = self._server.sockets[0].getsockname()[1]
+        self.membership.beat(self.idx, 0.0)
+        self._tasks.append(asyncio.create_task(self._heartbeat_loop()))
+
+    async def stop(self) -> None:
+        for t in [self._learn_task, *self._tasks]:
+            if t is not None:
+                t.cancel()
+                with contextlib.suppress(asyncio.CancelledError):
+                    await t
+        for peer in list(self.peers.values()):
+            if peer.reader_task:
+                peer.reader_task.cancel()
+                with contextlib.suppress(asyncio.CancelledError):
+                    await peer.reader_task
+            peer.writer.close()
+            with contextlib.suppress(Exception):
+                await asyncio.wait_for(peer.writer.wait_closed(), timeout=1.0)
+        self.peers.clear()
+        if self._server:
+            self._server.close()
+            # NOT wait_closed(): on py3.12 it blocks until every peer
+            # connection (including ones owned by other nodes) is gone
+
+    async def connect_to(self, host: str, port: int) -> None:
+        """Dial a neighbor (base_node.py connect_to)."""
+        reader, writer = await asyncio.open_connection(host, port)
+        await write_message(
+            writer, Message(MsgType.CONNECT, self.idx, {"port": self.port})
+        )
+        hello = await read_message(reader)
+        peer = self._register_peer(int(hello.sender), reader, writer)
+        log.debug("node %d connected to %d", self.idx, peer.idx)
+
+    async def _on_connection(self, reader, writer) -> None:
+        try:
+            hello = await read_message(reader)
+        except (asyncio.IncompleteReadError, ValueError):
+            writer.close()
+            return
+        if hello.type is not MsgType.CONNECT:
+            writer.close()
+            return
+        await write_message(
+            writer, Message(MsgType.CONNECT, self.idx, {"port": self.port})
+        )
+        self._register_peer(int(hello.sender), reader, writer)
+
+    def _register_peer(self, idx: int, reader, writer) -> PeerState:
+        peer = PeerState(idx=idx, writer=writer)
+        peer.reader_task = asyncio.create_task(self._read_loop(peer, reader))
+        self.peers[idx] = peer
+        self.membership.beat(idx)
+        return peer
+
+    # ------------------------------------------------------------------
+    # receive path
+    # ------------------------------------------------------------------
+    async def _read_loop(self, peer: PeerState, reader) -> None:
+        try:
+            while True:
+                msg = await read_message(reader)
+                await self._dispatch(peer, msg)
+        except (asyncio.IncompleteReadError, ConnectionError, ValueError):
+            self.peers.pop(peer.idx, None)
+
+    async def _dispatch(self, peer: PeerState, msg: Message) -> None:
+        if msg.type in GOSSIPED:
+            if not self.dedup.check_and_add(msg.msg_id):
+                return  # already processed — at-most-once
+            await self._forward(msg, exclude=peer.idx)
+        t = msg.type
+        if t is MsgType.BEAT:
+            self.membership.beat(msg.sender)
+        elif t is MsgType.ROLE:
+            self.peer_roles[msg.sender] = msg.body["role"]
+        elif t is MsgType.START_LEARNING:
+            if not self.learning:
+                self._start_learning(
+                    msg.body["rounds"], msg.body["epochs"],
+                    leader=msg.body.get("leader"),
+                )
+        elif t is MsgType.STOP_LEARNING:
+            self._stop_learning()
+        elif t is MsgType.PARAMS:
+            await self._on_params(peer, msg)
+        elif t is MsgType.MODELS_AGGREGATED:
+            peer.models_aggregated = set(msg.body["contributors"])
+        elif t is MsgType.MODEL_INITIALIZED:
+            peer.initialized = True
+        elif t is MsgType.MODELS_READY:
+            peer.ready_round = int(msg.body["round"])
+        elif t is MsgType.TRANSFER_LEADERSHIP:
+            self.leader = int(msg.body["to"])
+
+    async def _on_params(self, peer: PeerState, msg: Message) -> None:
+        payload = decode_parameters(msg.payload)
+        if msg.body.get("init"):
+            if not self.initialized:
+                self.learner.set_parameters(payload.params)
+                self.initialized = True
+                await self.broadcast(
+                    Message(MsgType.MODEL_INITIALIZED, self.idx)
+                )
+                # relay the initial weights onward — on multi-hop
+                # topologies (ring/random) the starter only reaches its
+                # direct neighbors, so every receiver re-diffuses
+                # (node.py:702-724 diffusion-until-initialized)
+                asyncio.create_task(self._diffuse_initial())
+            return
+        if self.session.waiting and not msg.body.get("aggregated"):
+            return  # waiting nodes adopt only a *finished* aggregate
+        covered = self.session.add_model(
+            payload.params, payload.contributors, payload.weight
+        )
+        if covered:
+            await self.broadcast(
+                Message(
+                    MsgType.MODELS_AGGREGATED, self.idx,
+                    {"contributors": sorted(covered)},
+                )
+            )
+
+    # ------------------------------------------------------------------
+    # send path
+    # ------------------------------------------------------------------
+    async def broadcast(self, msg: Message, exclude: int | None = None) -> None:
+        if msg.type in GOSSIPED:
+            self.dedup.check_and_add(msg.msg_id)
+        await self._forward(msg, exclude)
+
+    async def _forward(self, msg: Message, exclude: int | None = None) -> None:
+        for peer in list(self.peers.values()):
+            if peer.idx == exclude:
+                continue
+            try:
+                await write_message(peer.writer, msg)
+            except (ConnectionError, RuntimeError):
+                self.peers.pop(peer.idx, None)
+
+    async def _send_params(self, peer: PeerState, params, contributors,
+                           weight, **body) -> None:
+        blob = encode_parameters(params, tuple(contributors), int(weight))
+        try:
+            await write_message(
+                peer.writer,
+                Message(MsgType.PARAMS, self.idx, body, payload=blob),
+            )
+        except (ConnectionError, RuntimeError):
+            self.peers.pop(peer.idx, None)
+
+    # ------------------------------------------------------------------
+    # control plane loops
+    # ------------------------------------------------------------------
+    async def _heartbeat_loop(self) -> None:
+        period = self.protocol.heartbeat_period_s
+        while True:
+            self.membership.beat(self.idx)
+            await self.broadcast(Message(MsgType.BEAT, self.idx))
+            self.membership.advance_to(self.membership.clock + period)
+            await asyncio.sleep(period)
+
+    # ------------------------------------------------------------------
+    # learning
+    # ------------------------------------------------------------------
+    def set_start_learning(self, rounds: int, epochs: int = 1) -> None:
+        """Initiator entry point (node.py:224)."""
+        asyncio.create_task(self._kickoff(rounds, epochs))
+
+    async def _kickoff(self, rounds: int, epochs: int) -> None:
+        await self.broadcast(
+            Message(
+                MsgType.START_LEARNING, self.idx,
+                {"rounds": rounds, "epochs": epochs, "leader": self.idx
+                 if self.role in ("server", "aggregator") else None},
+            )
+        )
+        # initial model diffusion (node.py:299): push our weights until
+        # every peer reports initialized
+        self.initialized = True
+        self._start_learning(rounds, epochs, leader=self.idx)
+
+    def _start_learning(self, rounds, epochs, leader=None) -> None:
+        self.learning = True
+        self.total_rounds = rounds
+        self.epochs = epochs
+        if leader is not None:
+            self.leader = leader
+        asyncio.create_task(
+            self.broadcast(
+                Message(MsgType.ROLE, self.idx, {"role": self.role})
+            )
+        )  # heartbeater.py:74 SEND_ROLE analog — peers learn who aggregates
+        self._learn_task = asyncio.create_task(self._learning_loop())
+
+    def _stop_learning(self) -> None:
+        self.learning = False
+        if self._learn_task:
+            self._learn_task.cancel()
+        self.finished.set()
+
+    def _train_set(self) -> set[int]:
+        alive = set(self.membership.get_nodes())
+        return (alive & (set(self.peers) | {self.idx}))
+
+    async def _learning_loop(self) -> None:
+        ln = self.learner
+        ln.set_epochs(self.epochs)
+        if getattr(ln, "state", True) is None or getattr(ln, "fns", True) is None:
+            ln.init()
+        if self.initialized:
+            await self._diffuse_initial()
+        else:
+            # wait for the initializer's weights
+            while not self.initialized:
+                await asyncio.sleep(self.gossip_period_s)
+        while self.round < self.total_rounds:
+            await self._train_round()
+        self.learning = False
+        self.finished.set()
+
+    async def _diffuse_initial(self) -> None:
+        params = self.learner.get_parameters()
+        deadline = asyncio.get_event_loop().time() + self.protocol.aggregation_timeout_s
+        while (
+            any(not p.initialized for p in self.peers.values())
+            and asyncio.get_event_loop().time() < deadline
+        ):
+            for peer in list(self.peers.values()):
+                if not peer.initialized:
+                    await self._send_params(peer, params, (), 1, init=True)
+            await asyncio.sleep(self.gossip_period_s)
+
+    def _effective_role(self) -> str:
+        """SDFL: the aggregator role follows the leadership token
+        (node.py:649-686); other schemes use the static role."""
+        if self.federation == "SDFL":
+            return "aggregator" if self.leader == self.idx else "trainer"
+        return self.role
+
+    async def _train_round(self) -> None:
+        train_set = self._train_set()
+        self.session.clear()
+        role = self._effective_role()
+        if role in ("aggregator", "server"):
+            self.session.set_nodes_to_aggregate(train_set)
+            self.learner.fit()
+            n_samples = self.learner.get_num_samples()[0]
+            covered = self.session.add_model(
+                self.learner.get_parameters(), (self.idx,), n_samples
+            )
+            await self.broadcast(
+                Message(MsgType.MODELS_AGGREGATED, self.idx,
+                        {"contributors": sorted(covered)})
+            )
+            await self._gossip_until_done(train_set)
+        elif role == "trainer":
+            self.learner.fit()
+            n_samples = self.learner.get_num_samples()[0]
+            self.session.set_waiting_aggregated_model()
+            target = self.leader if self.leader in self.peers else None
+            sent_to = (
+                [self.peers[target]] if target is not None
+                else list(self.peers.values())
+            )
+            for peer in sent_to:
+                await self._send_params(
+                    peer, self.learner.get_parameters(), (self.idx,),
+                    n_samples,
+                )
+            await self._wait_done()
+        else:  # idle / proxy: adopt whatever aggregate arrives
+            self.session.set_waiting_aggregated_model()
+            await self._wait_done()
+
+        if self.session.result is not None:
+            params, _ = self.session.result
+            self.learner.set_parameters(params)
+        self.round += 1
+        self.learner.finalize_round()
+        await self.broadcast(
+            Message(MsgType.MODELS_READY, self.idx, {"round": self.round})
+        )
+        if self.federation == "SDFL" and self.leader == self.idx:
+            # rotate the aggregator token (node.py:676-686 "random")
+            candidates = sorted(self._train_set())
+            if candidates:
+                new_leader = self._rng.choice(candidates)
+                self.leader = new_leader
+                await self.broadcast(
+                    Message(MsgType.TRANSFER_LEADERSHIP, self.idx,
+                            {"to": new_leader})
+                )
+        await self._wait_neighbors_ready()
+
+    async def _gossip_until_done(self, train_set: set[int]) -> None:
+        """Partial-aggregation gossip (node.py:692-700 + 726-809):
+        send each stale peer the aggregate of models it lacks, until
+        the session completes (coverage or timeout)."""
+        fanout = max(self.protocol.gossip_models_per_round, 1)
+        while not self.session.check_and_run():
+            candidates = [
+                p for i, p in self.peers.items()
+                if i in train_set
+                and self.peer_roles.get(i, "aggregator")
+                in ("aggregator", "server")
+                and not (self.session.covered <= p.models_aggregated)
+            ]
+            random.shuffle(candidates)
+            for peer in candidates[:fanout]:
+                partial = self.session.get_partial_aggregation(
+                    peer.models_aggregated
+                )
+                if partial is None:
+                    continue
+                params, contribs, weight = partial
+                await self._send_params(peer, params, contribs, weight)
+            await asyncio.sleep(self.gossip_period_s)
+        # aggregation finished; if a full aggregate exists, also offer it
+        # to trainer/idle peers waiting for one (CFL/SDFL broadcast).
+        # gate on the *effective* role — an SDFL leader's static role
+        # may be "trainer"
+        role = self._effective_role()
+        if role == "server" or (
+            self.leader == self.idx and role == "aggregator"
+        ):
+            params, contribs = self.session.result
+            for peer in list(self.peers.values()):
+                await self._send_params(
+                    peer, params, contribs or tuple(sorted(train_set)), 1,
+                    aggregated=True,
+                )
+
+    async def _wait_done(self) -> None:
+        deadline = asyncio.get_event_loop().time() + self.session.timeout_s
+        while not self.session.done.is_set():
+            if asyncio.get_event_loop().time() > deadline:
+                break  # keep local params (timeout with nothing arrived)
+            await asyncio.sleep(self.gossip_period_s)
+
+    async def _wait_neighbors_ready(self) -> None:
+        """Round barrier: wait until alive neighbors report this round
+        (MODELS_READY gating, node.py:713), bounded by the timeout."""
+        deadline = asyncio.get_event_loop().time() + self.session.timeout_s
+        while asyncio.get_event_loop().time() < deadline:
+            alive = set(self.membership.get_nodes())
+            behind = [
+                p for i, p in self.peers.items()
+                if i in alive and p.ready_round < self.round
+            ]
+            if not behind:
+                return
+            await asyncio.sleep(self.gossip_period_s)

@@ -1,0 +1,135 @@
+"""Wire protocol: length-prefixed msgpack frames.
+
+Message-type parity with the reference's grammar
+(communication_protocol.py:37-54): gossiped (hash-deduped) BEAT /
+ROLE / START_LEARNING / STOP_LEARNING / VOTE_TRAIN_SET / METRICS and
+direct CONNECT / STOP / PARAMS / MODELS_READY / MODELS_AGGREGATED /
+MODEL_INITIALIZED / TRANSFER_LEADERSHIP — minus the parsing hazards:
+no text tokenization, no fixed-size padding, no collapse/incomplete
+reassembly (:497-530), because frames carry an explicit length and the
+PARAMS payload is the safe envelope from p2pfl_tpu.core.serialize.
+
+Gossip dedup keeps the reference's at-most-once contract
+(:146-160, :451-461): every gossipable message carries a random
+``msg_id``; receivers keep a bounded ring of seen ids.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import enum
+import secrets
+import struct
+from collections import OrderedDict
+from typing import Any
+
+import msgpack
+
+_LEN = struct.Struct(">I")
+MAX_FRAME = 1 << 30  # 1 GiB — a frame is at most one model payload
+
+
+class MsgType(enum.Enum):
+    # gossiped control messages (flooded, deduped by msg_id)
+    BEAT = "beat"
+    ROLE = "role"
+    START_LEARNING = "start_learning"
+    STOP_LEARNING = "stop_learning"
+    VOTE_TRAIN_SET = "vote_train_set"
+    METRICS = "metrics"
+    # direct messages
+    CONNECT = "connect"
+    STOP = "stop"
+    PARAMS = "params"
+    MODELS_READY = "models_ready"
+    MODELS_AGGREGATED = "models_aggregated"
+    MODEL_INITIALIZED = "model_initialized"
+    TRANSFER_LEADERSHIP = "transfer_leadership"
+
+
+GOSSIPED = frozenset(
+    {
+        MsgType.BEAT,
+        MsgType.ROLE,
+        MsgType.START_LEARNING,
+        MsgType.STOP_LEARNING,
+        MsgType.VOTE_TRAIN_SET,
+        MsgType.METRICS,
+    }
+)
+
+
+@dataclasses.dataclass
+class Message:
+    """One frame. ``sender`` is the originating node index; ``body`` is
+    msgpack-able metadata; ``payload`` carries binary blobs (PARAMS)."""
+
+    type: MsgType
+    sender: int
+    body: dict[str, Any] = dataclasses.field(default_factory=dict)
+    payload: bytes = b""
+    msg_id: str = ""
+
+    def __post_init__(self):
+        if not self.msg_id and self.type in GOSSIPED:
+            self.msg_id = secrets.token_hex(8)  # :536-548 hash analog
+
+    def encode(self) -> bytes:
+        frame = msgpack.packb(
+            {
+                "t": self.type.value,
+                "s": self.sender,
+                "b": self.body,
+                "p": self.payload,
+                "i": self.msg_id,
+            },
+            use_bin_type=True,
+        )
+        if len(frame) > MAX_FRAME:
+            raise ValueError(f"frame too large: {len(frame)} bytes")
+        return _LEN.pack(len(frame)) + frame
+
+    @staticmethod
+    def decode(frame: bytes) -> "Message":
+        obj = msgpack.unpackb(frame, raw=False)
+        return Message(
+            type=MsgType(obj["t"]),
+            sender=int(obj["s"]),
+            body=obj.get("b", {}),
+            payload=obj.get("p", b""),
+            msg_id=obj.get("i", ""),
+        )
+
+
+async def write_message(writer: asyncio.StreamWriter, msg: Message) -> None:
+    writer.write(msg.encode())
+    await writer.drain()
+
+
+async def read_message(reader: asyncio.StreamReader) -> Message:
+    """Read one frame; raises IncompleteReadError on EOF."""
+    header = await reader.readexactly(_LEN.size)
+    (length,) = _LEN.unpack(header)
+    if length > MAX_FRAME:
+        raise ValueError(f"peer announced oversized frame: {length}")
+    frame = await reader.readexactly(length)
+    return Message.decode(frame)
+
+
+class DedupRing:
+    """Bounded set of seen gossip msg_ids (AMOUNT_LAST_MESSAGES_SAVED
+    = 100 ring, communication_protocol.py:146-160)."""
+
+    def __init__(self, capacity: int = 100):
+        self.capacity = capacity
+        self._seen: OrderedDict[str, None] = OrderedDict()
+
+    def check_and_add(self, msg_id: str) -> bool:
+        """True if the id is new (message should be processed)."""
+        if not msg_id or msg_id in self._seen:
+            return False
+        self._seen[msg_id] = None
+        while len(self._seen) > self.capacity:
+            self._seen.popitem(last=False)
+        return True

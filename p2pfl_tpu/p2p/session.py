@@ -1,0 +1,158 @@
+"""Aggregation session: contributor-set bookkeeping for gossip mode.
+
+Behavior parity with the reference's Aggregator thread
+(learning/aggregators/aggregator.py), re-done as a plain object + an
+asyncio.Event instead of a daemon thread blocking on a lock (:40-49):
+
+- models are stored keyed by their **contributor set** (:151);
+- an incoming model is ignored if its contributors are already covered,
+  and it evicts stored models it supersedes (:135-158 dedup);
+- ``get_partial_aggregation(peer_has)`` builds the aggregate of models
+  the peer doesn't have yet (:181-208) — this is what makes gossip
+  converge without re-sending everything;
+- completion fires when the train set is covered (:210-229) or the
+  timeout expires, in which case whatever arrived is aggregated
+  (:53-76);
+- ``waiting`` mode (TRAINER/PROXY/IDLE, :93-123): the first full
+  aggregate that arrives is adopted as-is.
+
+The math is the pure aggregator from p2pfl_tpu.core.aggregators over a
+stacked tree — device-jittable even in the socket path.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Any
+
+import jax
+import numpy as np
+
+from p2pfl_tpu.core.aggregators import Aggregator, FedAvg
+from p2pfl_tpu.core.pytree import tree_stack
+
+Params = Any
+
+
+class AggregationSession:
+    """One round's aggregation state for one node."""
+
+    def __init__(self, aggregator: Aggregator | None = None,
+                 timeout_s: float = 60.0):
+        self.aggregator = aggregator or FedAvg()
+        self.timeout_s = timeout_s  # AGGREGATION_TIMEOUT
+        self.models: dict[frozenset[int], tuple[Params, float]] = {}
+        self.train_set: frozenset[int] = frozenset()
+        self.waiting = False
+        self.done = asyncio.Event()
+        self.result: tuple[Params, tuple[int, ...]] | None = None
+        self._deadline: float | None = None
+
+    # -- setup ----------------------------------------------------------
+    def set_nodes_to_aggregate(self, train_set) -> None:
+        self.train_set = frozenset(int(i) for i in train_set)
+        self._deadline = time.monotonic() + self.timeout_s
+
+    def set_waiting_aggregated_model(self) -> None:
+        """TRAINER/PROXY/IDLE: adopt the next aggregate received."""
+        self.waiting = True
+
+    # -- state ----------------------------------------------------------
+    @property
+    def covered(self) -> frozenset[int]:
+        out: frozenset[int] = frozenset()
+        for key in self.models:
+            out = out | key
+        return out
+
+    def timed_out(self) -> bool:
+        return self._deadline is not None and time.monotonic() > self._deadline
+
+    # -- adding models ---------------------------------------------------
+    def add_model(self, params: Params, contributors, weight: float) -> tuple[int, ...]:
+        """Returns the contributors now covered (broadcast as
+        MODELS_AGGREGATED, node.py:363-369). Empty tuple = rejected."""
+        contrib = frozenset(int(i) for i in contributors)
+        if not contrib:
+            return ()
+        if self.waiting:
+            self.result = (params, tuple(sorted(contrib)))
+            self.done.set()
+            return tuple(sorted(contrib))
+        if contrib <= self.covered:
+            return ()  # nothing new (aggregator.py:149 overlap guard)
+        # accept only if every contributor the incoming model shares
+        # with our store is explained by stored models it supersedes
+        # (k ⊆ contrib) — otherwise a partially-overlapping partial
+        # (e.g. {B,C} arriving over stored {C,D}) would double-count
+        # the shared contributor in the weighted mean
+        evict = [k for k in self.models if k <= contrib]
+        explained: frozenset[int] = frozenset()
+        for k in evict:
+            explained = explained | k
+        if (contrib & self.covered) - explained:
+            return ()  # overlapping but not superseding — reject
+        for key in evict:
+            del self.models[key]
+        self.models[contrib] = (params, float(weight))
+        if self.train_set and self.covered >= self.train_set:
+            self._finish()
+        return tuple(sorted(self.covered))
+
+    # -- partial aggregation for a peer ----------------------------------
+    def get_partial_aggregation(
+        self, peer_has
+    ) -> tuple[Params, tuple[int, ...], float] | None:
+        """Aggregate of stored models containing no contributor the
+        peer already has; None if there is nothing new to send."""
+        peer = frozenset(int(i) for i in peer_has)
+        send = [
+            (p, k, w) for k, (p, w) in self.models.items() if not (k & peer)
+        ]
+        if not send:
+            return None
+        params, contribs, weight = self._aggregate(
+            [(p, w) for p, k, w in send]
+        )
+        all_contrib: frozenset[int] = frozenset()
+        for _, k, _ in send:
+            all_contrib = all_contrib | k
+        return params, tuple(sorted(all_contrib)), weight
+
+    # -- completion -------------------------------------------------------
+    def check_and_run(self) -> bool:
+        """Called by the node loop: finish on coverage or timeout with
+        whatever arrived (aggregator.py:53-76)."""
+        if self.done.is_set():
+            return True
+        if self.models and (
+            (self.train_set and self.covered >= self.train_set)
+            or self.timed_out()
+        ):
+            self._finish()
+            return True
+        return False
+
+    def _finish(self) -> None:
+        params, contribs, _ = self._aggregate(list(self.models.values()))
+        self.result = (params, tuple(sorted(self.covered)))
+        self.done.set()
+
+    def _aggregate(self, entries) -> tuple[Params, tuple[int, ...], float]:
+        if len(entries) == 1:
+            p, w = entries[0]
+            return p, (), w
+        stacked = tree_stack([jax.tree.map(np.asarray, p) for p, _ in entries])
+        weights = np.asarray([w for _, w in entries], np.float32)
+        agg = self.aggregator(stacked, weights)
+        return jax.tree.map(np.asarray, agg), (), float(weights.sum())
+
+    def clear(self) -> None:
+        """Reset for the next round (aggregator.py:231-238)."""
+        self.models.clear()
+        self.train_set = frozenset()
+        self.waiting = False
+        self.result = None
+        self.done = asyncio.Event()
+        self._deadline = None

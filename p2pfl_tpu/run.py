@@ -104,7 +104,7 @@ def main(argv: list[str] | None = None) -> int:
         "n_nodes": cfg.n_nodes,
         "rounds": result.rounds_run,
         "final_accuracy": round(result.final_accuracy, 4),
-        "min_accuracy": round(min(result.per_node_accuracy), 4),
+        "min_accuracy": round(result.min_accuracy, 4),  # alive nodes only
         "mean_round_time_s": round(
             sum(result.round_times_s) / max(len(result.round_times_s), 1), 4
         ),

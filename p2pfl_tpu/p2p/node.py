@@ -97,13 +97,25 @@ class P2PNode:
         self.membership = Membership(n_nodes, self.protocol, virtual=False)
         self.peers: dict[int, PeerState] = {}
         self.peer_roles: dict[int, str] = {}
-        self.dedup = DedupRing()
+        # capacity scales with federation size: BEATs from every node
+        # share this ring, and 100 ids evict before a flood quiesces
+        # once ~100 gossip ids are in flight per eviction window
+        self.dedup = DedupRing(capacity=max(100, 20 * n_nodes))
         self.round = 0
         self.total_rounds = 0
         self.epochs = 1
         self.initialized = False
         self.learning = False
         self.leader: int | None = None
+        # every leadership token position this node observed, in order —
+        # tests and monitoring assert on the rotation *history*, not the
+        # chance-dependent final position
+        self.leader_history: list[int] = []
+        # weight messages that arrived for a FUTURE round (a fast peer
+        # past the barrier) or outside an active round body — replayed
+        # when this node's round body reaches them
+        self._pending_params: list[tuple[PeerState, Message]] = []
+        self._round_active = False
         self._server: asyncio.Server | None = None
         self._tasks: list[asyncio.Task] = []
         self._learn_task: asyncio.Task | None = None
@@ -210,11 +222,12 @@ class P2PNode:
             peer.ready_round = int(msg.body["round"])
         elif t is MsgType.TRANSFER_LEADERSHIP:
             self.leader = int(msg.body["to"])
+            self.leader_history.append(self.leader)
 
     async def _on_params(self, peer: PeerState, msg: Message) -> None:
-        payload = decode_parameters(msg.payload)
         if msg.body.get("init"):
             if not self.initialized:
+                payload = decode_parameters(msg.payload)
                 self.learner.set_parameters(payload.params)
                 self.initialized = True
                 await self.broadcast(
@@ -226,8 +239,24 @@ class P2PNode:
                 # (node.py:702-724 diffusion-until-initialized)
                 asyncio.create_task(self._diffuse_initial())
             return
+        # round fencing: a round-r model must never enter a round-r'
+        # session (a stale full aggregate would instantly "cover" a
+        # fresh session and erase this round's training). Messages for
+        # a future round — or for the current round while we are still
+        # in the previous round's barrier (self.round is incremented
+        # BEFORE the barrier, so the session is stale there) — are
+        # buffered and replayed at that round's start.
+        msg_round = int(msg.body.get("round", self.round))
+        if msg_round > self.round or (
+            msg_round == self.round and not self._round_active
+        ):
+            self._pending_params.append((peer, msg))
+            return
+        if msg_round < self.round:
+            return  # stale leftover from a finished round
         if self.session.waiting and not msg.body.get("aggregated"):
             return  # waiting nodes adopt only a *finished* aggregate
+        payload = decode_parameters(msg.payload)
         covered = self.session.add_model(
             payload.params, payload.contributors, payload.weight
         )
@@ -258,6 +287,7 @@ class P2PNode:
 
     async def _send_params(self, peer: PeerState, params, contributors,
                            weight, **body) -> None:
+        body.setdefault("round", self.round)
         blob = encode_parameters(params, tuple(contributors), int(weight))
         try:
             await write_message(
@@ -304,6 +334,7 @@ class P2PNode:
         self.epochs = epochs
         if leader is not None:
             self.leader = leader
+            self.leader_history.append(leader)
         asyncio.create_task(
             self.broadcast(
                 Message(MsgType.ROLE, self.idx, {"role": self.role})
@@ -356,13 +387,41 @@ class P2PNode:
             return "aggregator" if self.leader == self.idx else "trainer"
         return self.role
 
+    async def _fit(self) -> None:
+        """Local training off the event loop: a blocking device call in
+        line would starve heartbeats/gossip for the whole epoch and get
+        peers evicted by membership timeouts."""
+        await asyncio.get_running_loop().run_in_executor(
+            None, self.learner.fit
+        )
+
     async def _train_round(self) -> None:
         train_set = self._train_set()
         self.session.clear()
+        # Snapshot the effective role and token position for the WHOLE
+        # round: a TRANSFER_LEADERSHIP that lands mid-round must not
+        # flip this round's behavior (it takes effect next round), or a
+        # node that both led and received the token would rotate twice
+        # in one round.
         role = self._effective_role()
+        leader_at_start = self.leader
+        # session mode is set BEFORE fit (which runs in an executor)
+        # and BEFORE replaying buffered messages: an aggregate arriving
+        # mid-fit or buffered from a fast peer must be adopted by a
+        # waiting node, not mistaken for a regular partial contribution
         if role in ("aggregator", "server"):
             self.session.set_nodes_to_aggregate(train_set)
-            self.learner.fit()
+        else:
+            self.session.set_waiting_aggregated_model()
+        self._round_active = True
+        # replay weight messages that arrived before this round's
+        # session was ready for them
+        pending, self._pending_params = self._pending_params, []
+        for peer, msg in pending:
+            if peer.idx in self.peers:
+                await self._on_params(peer, msg)
+        if role in ("aggregator", "server"):
+            await self._fit()
             n_samples = self.learner.get_num_samples()[0]
             covered = self.session.add_model(
                 self.learner.get_parameters(), (self.idx,), n_samples
@@ -371,12 +430,13 @@ class P2PNode:
                 Message(MsgType.MODELS_AGGREGATED, self.idx,
                         {"contributors": sorted(covered)})
             )
-            await self._gossip_until_done(train_set)
+            await self._gossip_until_done(train_set, role, leader_at_start)
         elif role == "trainer":
-            self.learner.fit()
+            await self._fit()
             n_samples = self.learner.get_num_samples()[0]
-            self.session.set_waiting_aggregated_model()
-            target = self.leader if self.leader in self.peers else None
+            target = (
+                leader_at_start if leader_at_start in self.peers else None
+            )
             sent_to = (
                 [self.peers[target]] if target is not None
                 else list(self.peers.values())
@@ -388,33 +448,45 @@ class P2PNode:
                 )
             await self._wait_done()
         else:  # idle / proxy: adopt whatever aggregate arrives
-            self.session.set_waiting_aggregated_model()
             await self._wait_done()
 
         if self.session.result is not None:
             params, _ = self.session.result
             self.learner.set_parameters(params)
+        self._round_active = False  # barrier window: buffer, don't drop
         self.round += 1
         self.learner.finalize_round()
-        await self.broadcast(
-            Message(MsgType.MODELS_READY, self.idx, {"round": self.round})
-        )
-        if self.federation == "SDFL" and self.leader == self.idx:
-            # rotate the aggregator token (node.py:676-686 "random")
-            candidates = sorted(self._train_set())
+        if self.federation == "SDFL" and role == "aggregator":
+            # Rotate the aggregator token (node.py:676-686 "random",
+            # excluding self like the reference's choice of neighbors).
+            # Rotation is decided by the node that LED this round (the
+            # snapshot above), and broadcast BEFORE MODELS_READY: the
+            # per-peer TCP stream is ordered, so no peer can observe our
+            # round completion (and exit its round barrier) without
+            # having the new token — the next round always starts with
+            # exactly one leader everywhere.
+            candidates = sorted(self._train_set() - {self.idx})
             if candidates:
                 new_leader = self._rng.choice(candidates)
                 self.leader = new_leader
+                self.leader_history.append(new_leader)
                 await self.broadcast(
                     Message(MsgType.TRANSFER_LEADERSHIP, self.idx,
                             {"to": new_leader})
                 )
+        await self.broadcast(
+            Message(MsgType.MODELS_READY, self.idx, {"round": self.round})
+        )
         await self._wait_neighbors_ready()
 
-    async def _gossip_until_done(self, train_set: set[int]) -> None:
+    async def _gossip_until_done(
+        self, train_set: set[int], role: str, leader_at_start: int | None
+    ) -> None:
         """Partial-aggregation gossip (node.py:692-700 + 726-809):
         send each stale peer the aggregate of models it lacks, until
-        the session completes (coverage or timeout)."""
+        the session completes (coverage or timeout). ``role`` and
+        ``leader_at_start`` are the caller's round-start snapshot — the
+        live token may have moved mid-round."""
         fanout = max(self.protocol.gossip_models_per_round, 1)
         while not self.session.check_and_run():
             candidates = [
@@ -435,12 +507,9 @@ class P2PNode:
                 await self._send_params(peer, params, contribs, weight)
             await asyncio.sleep(self.gossip_period_s)
         # aggregation finished; if a full aggregate exists, also offer it
-        # to trainer/idle peers waiting for one (CFL/SDFL broadcast).
-        # gate on the *effective* role — an SDFL leader's static role
-        # may be "trainer"
-        role = self._effective_role()
+        # to trainer/idle peers waiting for one (CFL/SDFL broadcast)
         if role == "server" or (
-            self.leader == self.idx and role == "aggregator"
+            leader_at_start == self.idx and role == "aggregator"
         ):
             params, contribs = self.session.result
             for peer in list(self.peers.values()):

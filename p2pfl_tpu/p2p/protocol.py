@@ -38,6 +38,9 @@ class MsgType(enum.Enum):
     STOP_LEARNING = "stop_learning"
     VOTE_TRAIN_SET = "vote_train_set"
     METRICS = "metrics"
+    # transfer rides the gossip flood: on multi-hop overlays every node
+    # must learn the new token, not just the old leader's direct peers
+    TRANSFER_LEADERSHIP = "transfer_leadership"
     # direct messages
     CONNECT = "connect"
     STOP = "stop"
@@ -45,7 +48,6 @@ class MsgType(enum.Enum):
     MODELS_READY = "models_ready"
     MODELS_AGGREGATED = "models_aggregated"
     MODEL_INITIALIZED = "model_initialized"
-    TRANSFER_LEADERSHIP = "transfer_leadership"
 
 
 GOSSIPED = frozenset(
@@ -56,6 +58,7 @@ GOSSIPED = frozenset(
         MsgType.STOP_LEARNING,
         MsgType.VOTE_TRAIN_SET,
         MsgType.METRICS,
+        MsgType.TRANSFER_LEADERSHIP,
     }
 )
 

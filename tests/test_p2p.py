@@ -225,9 +225,13 @@ def test_sdfl_socket_federation_rotates():
         )
         try:
             assert all(node.round == 3 for node in nodes)
-            # the leadership token moved at least once off node 0
-            leaders = {node.leader for node in nodes}
-            assert leaders and leaders != {0}
+            # the leadership token moved at least once off node 0 at
+            # SOME point — assert on the rotation history, not the
+            # final position (the token can legally end back at 0)
+            history = [h for node in nodes for h in node.leader_history]
+            assert any(leader != 0 for leader in history), history
+            # every node observed the same final token position
+            assert len({node.leader for node in nodes}) == 1
             # rotated leaders (static role "trainer") must still have
             # broadcast the finished aggregate: everyone agrees
             k0 = np.asarray(

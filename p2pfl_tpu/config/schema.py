@@ -117,6 +117,11 @@ class ScenarioConfig:
     protocol: ProtocolConfig = dataclasses.field(default_factory=ProtocolConfig)
     aggregator: str = "fedavg"
     aggregator_kwargs: dict[str, Any] = dataclasses.field(default_factory=dict)
+    # weight-exchange collective schedule: "dense" = all-gather einsum;
+    # "sparse" = per-edge-offset ppermute (O(degree) ICI traffic, DFL +
+    # one node per device only); "auto" picks sparse when it is legal
+    # and the topology is sparse enough to win
+    transport: str = "auto"
     nodes: list[NodeConfig] = dataclasses.field(default_factory=list)
     faults: list[FaultEvent] = dataclasses.field(default_factory=list)
     seed: int = 0
@@ -128,6 +133,11 @@ class ScenarioConfig:
         if self.federation not in FEDERATIONS:
             raise ValueError(
                 f"unknown federation {self.federation!r}; have {FEDERATIONS}"
+            )
+        if self.transport not in ("auto", "dense", "sparse"):
+            raise ValueError(
+                f"unknown transport {self.transport!r}; "
+                "have ('auto', 'dense', 'sparse')"
             )
         if self.n_nodes < 1:
             raise ValueError("n_nodes must be >= 1")

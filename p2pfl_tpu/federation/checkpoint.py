@@ -9,6 +9,7 @@ can resume exactly where it stopped.
 
 from __future__ import annotations
 
+import os
 import pathlib
 from typing import Any
 
@@ -35,15 +36,24 @@ def save_checkpoint(directory: str | pathlib.Path, fed: FederatedState) -> pathl
     # nested dicts that msgpack can carry
     blob = flax_ser.msgpack_serialize(flax_ser.to_state_dict(host))
     path = checkpoint_path(directory, int(host.round))
-    path.write_bytes(blob)
+    # atomic publish: a crash mid-write must never leave a truncated
+    # round_NNNNN file for latest_checkpoint to pick up
+    tmp = path.with_suffix(path.suffix + ".tmp")
+    tmp.write_bytes(blob)
+    os.replace(tmp, path)
     return path
 
 
-def latest_checkpoint(directory: str | pathlib.Path) -> pathlib.Path | None:
+def all_checkpoints(directory: str | pathlib.Path) -> list[pathlib.Path]:
+    """Checkpoint files, oldest first."""
     directory = pathlib.Path(directory)
     if not directory.is_dir():
-        return None
-    ckpts = sorted(directory.glob(f"round_*{_SUFFIX}"))
+        return []
+    return sorted(directory.glob(f"round_*{_SUFFIX}"))
+
+
+def latest_checkpoint(directory: str | pathlib.Path) -> pathlib.Path | None:
+    ckpts = all_checkpoints(directory)
     return ckpts[-1] if ckpts else None
 
 

@@ -29,10 +29,10 @@ import jax.numpy as jnp
 import numpy as np
 
 from p2pfl_tpu.config.schema import ScenarioConfig
-from p2pfl_tpu.core.aggregators import get_aggregator
+from p2pfl_tpu.core.aggregators import FedAvg, get_aggregator
 from p2pfl_tpu.datasets import FederatedDataset
 from p2pfl_tpu.federation.checkpoint import (
-    latest_checkpoint,
+    all_checkpoints,
     load_checkpoint,
     save_checkpoint,
 )
@@ -44,10 +44,11 @@ from p2pfl_tpu.parallel.federated import (
     FederatedState,
     build_eval_fn,
     build_round_fn,
+    build_round_fn_sparse,
     init_federation,
     make_round_plan,
 )
-from p2pfl_tpu.parallel.transport import MeshTransport
+from p2pfl_tpu.parallel.transport import MeshTransport, edge_offsets
 from p2pfl_tpu.topology.topology import generate_topology
 from p2pfl_tpu.utils.metrics import MetricsLogger
 from p2pfl_tpu.utils.telemetry import resource_snapshot
@@ -113,10 +114,18 @@ class Scenario(Observable):
         )
         self._x_test = tr.put_replicated(jnp.asarray(self.dataset.x_test))
         self._y_test = tr.put_replicated(jnp.asarray(self.dataset.y_test))
-        self._round_fn = tr.compile_round(
-            build_round_fn(self.fns, aggregator=self.aggregator,
-                           epochs=config.training.epochs_per_round)
-        )
+        self.sparse_transport = self._choose_sparse()
+        if self.sparse_transport:
+            round_fn = build_round_fn_sparse(
+                self.fns, self.topology, tr.mesh,
+                epochs=config.training.epochs_per_round,
+            )
+        else:
+            round_fn = build_round_fn(
+                self.fns, aggregator=self.aggregator,
+                epochs=config.training.epochs_per_round,
+            )
+        self._round_fn = tr.compile_round(round_fn)
         self._eval_fn = tr.compile_eval(build_eval_fn(self.fns))
         self.fed = tr.put_stacked(
             init_federation(self.fns, jnp.asarray(x[0, :1]), n,
@@ -134,20 +143,58 @@ class Scenario(Observable):
         self._plan_cache: dict[int, tuple] = {}
 
     # ------------------------------------------------------------------
+    def _choose_sparse(self) -> bool:
+        """Pick the collective schedule for weight exchange.
+
+        The ppermute path is legal only for DFL (identity adopt) with
+        FedAvg and one node per mesh slot. Bandwidth model: the stacked
+        all-gather moves (n-1) x |params| through each ICI link; each
+        ppermute moves |params| — so sparse wins when #offsets < n-1
+        (ring: 2 vs n-1). At equality the all-gather's single fused
+        collective has better latency, so prefer dense.
+        """
+        cfg = self.config
+        legal = (
+            cfg.federation == "DFL"
+            and self.transport.n_devices == cfg.n_nodes
+            and type(self.aggregator) is FedAvg
+        )
+        if cfg.transport == "dense":
+            return False
+        if cfg.transport == "sparse":
+            if not legal:
+                raise ValueError(
+                    "transport='sparse' needs DFL + FedAvg + one node "
+                    f"per device (n_nodes={cfg.n_nodes}, "
+                    f"n_devices={self.transport.n_devices}, "
+                    f"federation={cfg.federation})"
+                )
+            return True
+        return legal and len(edge_offsets(self.topology)) < cfg.n_nodes - 1
+
     def _maybe_resume(self) -> None:
         if not self.config.checkpoint_dir:
             return
-        path = latest_checkpoint(self.config.checkpoint_dir)
-        if path is None:
+        restored = None
+        # newest first, falling back past any corrupt/truncated file
+        for path in reversed(all_checkpoints(self.config.checkpoint_dir)):
+            try:
+                restored = load_checkpoint(path, self.fed)
+                break
+            except ValueError:
+                continue
+        if restored is None:
             return
-        self.fed = self.transport.put_stacked(load_checkpoint(path, self.fed))
-        # replay the membership trajectory through the checkpointed
-        # rounds — identical fault application and clock advancement to
-        # the uninterrupted run, so eviction timing (and therefore every
-        # subsequent mix weight) matches exactly
+        self.fed = self.transport.put_stacked(restored)
+        # replay the host trajectory through the checkpointed rounds —
+        # identical fault application, clock advancement AND leadership
+        # rotation (advancing self._rng through the same draw sequence)
+        # as the uninterrupted run, so eviction timing, the leader, and
+        # every subsequent mix weight match exactly
         start_round = int(np.asarray(self.fed.round))
         for r in range(start_round):
-            self._advance_membership(r)
+            alive = self._advance_membership(r)
+            self._rotate_leader(alive, replay=True)
 
     def _advance_membership(self, round_num: int) -> np.ndarray:
         for fault in self._faults_by_round.get(round_num, []):
@@ -159,7 +206,7 @@ class Scenario(Observable):
         t = self.membership.clock + self.membership.protocol.heartbeat_period_s
         return self.membership.advance_to(t)
 
-    def _rotate_leader(self, alive: np.ndarray) -> None:
+    def _rotate_leader(self, alive: np.ndarray, replay: bool = False) -> None:
         if self.config.federation == "SDFL":
             candidates = [
                 i for i in np.flatnonzero(alive)
@@ -167,7 +214,7 @@ class Scenario(Observable):
             ]
             if candidates:
                 new = int(self._rng.choice(candidates))
-                if new != self.leader:
+                if new != self.leader and not replay:
                     self.notify(Events.LEADERSHIP_TRANSFERRED,
                                 {"from": self.leader, "to": new})
                 self.leader = new

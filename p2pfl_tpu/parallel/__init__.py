@@ -18,10 +18,15 @@ from p2pfl_tpu.parallel.mesh import (
 from p2pfl_tpu.parallel.federated import (
     FederatedState,
     build_round_fn,
+    build_round_fn_sparse,
     init_federation,
     make_mixing_matrix,
 )
-from p2pfl_tpu.parallel.transport import MeshTransport, neighbor_exchange
+from p2pfl_tpu.parallel.transport import (
+    MeshTransport,
+    edge_offsets,
+    neighbor_exchange,
+)
 
 __all__ = [
     "federation_mesh",
@@ -29,8 +34,10 @@ __all__ = [
     "stacked_sharding",
     "FederatedState",
     "build_round_fn",
+    "build_round_fn_sparse",
     "init_federation",
     "make_mixing_matrix",
     "MeshTransport",
+    "edge_offsets",
     "neighbor_exchange",
 ]

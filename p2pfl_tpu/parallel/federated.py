@@ -105,6 +105,25 @@ def _tree_sel(cond: jax.Array, a, b):
     return jax.tree.map(leaf, a, b)
 
 
+def _train_and_select(fns: StepFns, states: TrainState, alive, trains,
+                      x, y, smask, epochs: int):
+    """Local epochs on every node, keeping updates only where
+    ``trains & alive`` (proxy/idle/dead nodes stay frozen —
+    node.py:492-524). Shared by the dense and sparse round builders so
+    training-selection semantics can't drift between them."""
+    new_states, train_metrics = jax.vmap(
+        fns.train_epochs, in_axes=(0, 0, 0, 0, None)
+    )(states, x, y, smask, epochs)
+    sel = jnp.logical_and(trains, alive)
+    states = TrainState(
+        params=_tree_sel(sel, new_states.params, states.params),
+        opt_state=_tree_sel(sel, new_states.opt_state, states.opt_state),
+        rng=jnp.where(sel[:, None], new_states.rng, states.rng),
+        step=jnp.where(sel, new_states.step, states.step),
+    )
+    return states, train_metrics
+
+
 def init_federation(
     fns: StepFns, sample_x: jax.Array, n_nodes: int, seed: int = 0,
     same_init: bool = True,
@@ -150,19 +169,11 @@ def build_round_fn(
     fedavg_fast = type(aggregator) is FedAvg
 
     def round_fn(fed: FederatedState, x, y, smask, n_samples, mix, adopt, trains):
-        states = fed.states
         alive = fed.alive
 
         # ---- local training (every node; results masked in afterward)
-        new_states, train_metrics = jax.vmap(
-            fns.train_epochs, in_axes=(0, 0, 0, 0, None)
-        )(states, x, y, smask, epochs)
-        sel = jnp.logical_and(trains, alive)
-        states = TrainState(
-            params=_tree_sel(sel, new_states.params, states.params),
-            opt_state=_tree_sel(sel, new_states.opt_state, states.opt_state),
-            rng=jnp.where(sel[:, None], new_states.rng, states.rng),
-            step=jnp.where(sel, new_states.step, states.step),
+        states, train_metrics = _train_and_select(
+            fns, fed.states, alive, trains, x, y, smask, epochs
         )
 
         # ---- weight exchange + aggregation
@@ -208,6 +219,81 @@ def build_round_fn(
         return fed, metrics
 
     return round_fn
+
+
+def build_round_fn_sparse(
+    fns: StepFns,
+    topology: Topology,
+    mesh,
+    epochs: int = 1,
+) -> Callable:
+    """The sparse-topology round: O(degree) ``ppermute`` hops over ICI
+    instead of the dense all-gather einsum.
+
+    One federated node per mesh slot (requires ``topology.n ==
+    mesh.size``), DFL only (``adopt`` must be the identity — CFL/SDFL
+    route everything through one leader, where a gather is the natural
+    collective, so they stay on :func:`build_round_fn`). The per-round
+    plan arrays keep the SAME signature as the dense round fn, so the
+    two programs are drop-in interchangeable and parity-testable.
+
+    On a ring (the reference's watts_strogatz(n,2,0) topology,
+    topologymanager.py:213-228) this moves 2 × |params| per node per
+    round instead of n × |params| — the reference's per-neighbor TCP
+    sends (node.py:726-809) become exactly #offsets ppermutes.
+    """
+    from jax import shard_map
+    from jax.sharding import PartitionSpec
+
+    from p2pfl_tpu.parallel.mesh import NODES_AXIS
+    from p2pfl_tpu.parallel.transport import neighbor_exchange
+
+    if topology.n != mesh.size:
+        raise ValueError(
+            f"sparse round needs one node per mesh slot: "
+            f"{topology.n} nodes vs {mesh.size} devices"
+        )
+
+    Pn = PartitionSpec(NODES_AXIS)
+    Pr = PartitionSpec()
+    fed_spec = FederatedState(states=Pn, alive=Pn, round=Pr)
+
+    def round_body(fed: FederatedState, x, y, smask, n_samples, mix, adopt, trains):
+        # every block arrives with a leading node axis of size 1
+        del adopt  # identity by contract (DFL)
+        alive = fed.alive
+
+        states, train_metrics = _train_and_select(
+            fns, fed.states, alive, trains, x, y, smask, epochs
+        )
+
+        contrib = jnp.logical_and(trains, alive)
+        my_w = (n_samples.astype(jnp.float32) * contrib)[0]
+        local = jax.tree.map(lambda p: p[0], states.params)
+        agg, total = neighbor_exchange(
+            local, my_w, mix[0], topology, NODES_AXIS
+        )
+        keep = jnp.logical_and(alive[0], total > 0)
+        params = jax.tree.map(
+            lambda a, p: jnp.where(keep, a.astype(p.dtype), p[0])[None],
+            agg, states.params,
+        )
+        fed = FederatedState(
+            states=states.replace(params=params),
+            alive=alive,
+            round=fed.round + 1,
+        )
+        metrics = {"train_loss": train_metrics["loss"], "alive": alive}
+        return fed, metrics
+
+    sharded = shard_map(
+        round_body,
+        mesh=mesh,
+        in_specs=(fed_spec, Pn, Pn, Pn, Pn, Pn, Pn, Pn),
+        out_specs=(fed_spec, {"train_loss": Pn, "alive": Pn}),
+        check_vma=False,
+    )
+    return sharded
 
 
 def build_eval_fn(fns: StepFns) -> Callable:

@@ -54,37 +54,49 @@ def edge_offsets(topology: Topology) -> list[int]:
 
 def neighbor_exchange(
     params: Any,
-    weights: jnp.ndarray,
+    my_weight: jnp.ndarray,
+    row: jnp.ndarray,
     topology: Topology,
     axis_name: str = NODES_AXIS,
-) -> Any:
+) -> tuple[Any, jnp.ndarray]:
     """Weighted neighborhood average via ``ppermute`` — for use inside
     ``shard_map`` with one node per mesh slot.
 
-    ``params``: local (unstacked) pytree; ``weights``: this node's full
-    mixing row ``[n]``. Each circulant offset k contributes one
-    ppermute shifting every node's params k steps around the mesh;
-    receivers scale by their row weight for that sender. Total ICI
-    traffic = (#offsets) × |params| instead of all-gather's n × |params|.
+    ``params``: this node's (unstacked) pytree; ``my_weight``: this
+    node's contribution weight (sample count × alive × trains — zero
+    means "I contribute nothing", matching the round fn's contribution
+    gate); ``row``: this node's full mixing row ``[n]`` (0 = no edge).
+
+    Each circulant offset k contributes one ppermute shifting every
+    node's (params, weight) k steps around the mesh; receivers scale by
+    ``row[sender] * sender_weight``. Offsets over-approximate on
+    non-circulant graphs, but ``row`` zeroes non-edges, so correctness
+    holds. Total ICI traffic = (#offsets) × |params| instead of
+    all-gather's n × |params| — O(degree) for rings/chords.
+
+    Returns ``(mean_f32, total_weight)``; the caller keeps its own
+    params where ``total_weight == 0`` (the nothing-arrived timeout
+    analog, aggregator.py:53-76).
     """
     n = topology.n
     idx = jax.lax.axis_index(axis_name)
-    self_w = weights[idx]
-    acc = jax.tree.map(lambda p: p.astype(jnp.float32) * self_w, params)
-    total = self_w
+    w_self = row[idx] * my_weight
+    acc = jax.tree.map(lambda p: p.astype(jnp.float32) * w_self, params)
+    total = w_self
     for k in edge_offsets(topology):
         perm = [(i, (i + k) % n) for i in range(n)]  # src -> dst
         shifted = jax.tree.map(
             lambda p: jax.lax.ppermute(p, axis_name, perm), params
         )
+        w_recv = jax.lax.ppermute(my_weight, axis_name, perm)
         sender = (idx - k) % n
-        w = weights[sender]
+        wk = row[sender] * w_recv
         acc = jax.tree.map(
-            lambda a, s: a + s.astype(jnp.float32) * w, acc, shifted
+            lambda a, s: a + s.astype(jnp.float32) * wk, acc, shifted
         )
-        total = total + w
-    total = jnp.maximum(total, 1e-9)
-    return jax.tree.map(lambda a, p: (a / total).astype(p.dtype), acc, params)
+        total = total + wk
+    denom = jnp.maximum(total, 1e-9)
+    return jax.tree.map(lambda a: a / denom, acc), total
 
 
 class MeshTransport:

@@ -1,0 +1,156 @@
+"""Sparse ppermute transport: parity with the dense einsum round.
+
+The repo's central TPU-native claim — O(degree) ppermute hops over ICI
+instead of the O(n) all-gather (parallel/transport.neighbor_exchange) —
+validated structurally on the 8-device virtual CPU mesh: the sparse
+round program must produce the same federation state as the dense one
+for the same plan, including sample weighting, dead nodes, and
+non-circulant topologies.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from p2pfl_tpu.config.schema import DataConfig, ScenarioConfig
+from p2pfl_tpu.datasets import FederatedDataset
+from p2pfl_tpu.learning.learner import make_step_fns
+from p2pfl_tpu.models import get_model
+from p2pfl_tpu.parallel.federated import (
+    build_round_fn,
+    build_round_fn_sparse,
+    init_federation,
+    make_round_plan,
+)
+from p2pfl_tpu.parallel.transport import MeshTransport, edge_offsets
+from p2pfl_tpu.topology.topology import generate_topology
+
+N = 8
+
+
+@pytest.fixture(scope="module")
+def setup():
+    ds = FederatedDataset.make(
+        DataConfig(dataset="mnist", samples_per_node=150), N
+    )
+    x, y, smask, nsamp = ds.stacked()
+    # deliberately unequal sample counts: weighting parity matters
+    nsamp = np.arange(50, 50 + 10 * N, 10, dtype=nsamp.dtype)
+    fns = make_step_fns(get_model("mnist-mlp"), learning_rate=0.05,
+                        batch_size=32)
+    tr = MeshTransport(N)
+    data = tuple(
+        tr.put_stacked(jnp.asarray(a)) for a in (x, y, smask, nsamp)
+    )
+    return fns, tr, data
+
+
+def _plan_args(tr, plan):
+    return (
+        tr.put_stacked(jnp.asarray(plan.mix)),
+        tr.put_stacked(jnp.asarray(plan.adopt)),
+        tr.put_stacked(jnp.asarray(plan.trains)),
+    )
+
+
+def _run_both(fns, tr, data, topo, alive=None, rounds=2):
+    plan = make_round_plan(topo, ["aggregator"] * N, "DFL")
+    outs = []
+    for build in (
+        lambda: build_round_fn(fns, epochs=1),
+        lambda: build_round_fn_sparse(fns, topo, tr.mesh, epochs=1),
+    ):
+        fed = tr.put_stacked(init_federation(fns, data[0][0, :1], N))
+        if alive is not None:
+            fed = fed.replace(alive=tr.put_stacked(jnp.asarray(alive)))
+        round_fn = tr.compile_round(build())
+        for _ in range(rounds):
+            fed, metrics = round_fn(fed, *data, *_plan_args(tr, plan))
+        outs.append((jax.tree.map(np.asarray, fed), metrics))
+    return outs
+
+
+def _assert_fed_close(fa, fb):
+    for pa, pb in zip(
+        jax.tree.leaves(fa.states.params), jax.tree.leaves(fb.states.params)
+    ):
+        # einsum vs sequential ppermute accumulation differ only in
+        # float summation order; drift compounds through training steps
+        np.testing.assert_allclose(pa, pb, rtol=1e-3, atol=1e-4)
+    np.testing.assert_array_equal(fa.alive, fb.alive)
+    assert int(fa.round) == int(fb.round)
+
+
+def test_ring_offsets_are_two():
+    topo = generate_topology("ring", N)
+    assert edge_offsets(topo) == [1, N - 1]
+
+
+def test_parity_ring(setup):
+    fns, tr, data = setup
+    (fa, ma), (fb, mb) = _run_both(fns, tr, data, generate_topology("ring", N))
+    _assert_fed_close(fa, fb)
+    np.testing.assert_allclose(
+        np.asarray(ma["train_loss"]), np.asarray(mb["train_loss"]),
+        rtol=1e-4,
+    )
+
+
+def test_parity_noncirculant_random(setup):
+    """Random symmetric graph: offsets over-approximate; the mix row
+    must zero non-edges so parity still holds."""
+    fns, tr, data = setup
+    topo = generate_topology("random", N, prob=0.4, seed=3)
+    (fa, _), (fb, _) = _run_both(fns, tr, data, topo, rounds=1)
+    _assert_fed_close(fa, fb)
+
+
+def test_parity_with_dead_node(setup):
+    fns, tr, data = setup
+    alive = np.ones(N, bool)
+    alive[3] = False
+    (fa, _), (fb, _) = _run_both(
+        fns, tr, data, generate_topology("ring", N), alive=alive, rounds=1
+    )
+    _assert_fed_close(fa, fb)
+    # the dead node contributed nothing and stayed frozen in both
+    init = init_federation(fns, np.asarray(data[0])[0, :1], N)
+    for p0, pa in zip(
+        jax.tree.leaves(init.states.params), jax.tree.leaves(fa.states.params)
+    ):
+        np.testing.assert_array_equal(np.asarray(p0)[3], pa[3])
+
+
+def test_scenario_auto_selects_sparse():
+    cfg = ScenarioConfig(
+        name="sparse-auto", n_nodes=N, topology="ring",
+        data=DataConfig(dataset="mnist", samples_per_node=100),
+    )
+    from p2pfl_tpu.federation.scenario import Scenario
+
+    sc = Scenario(cfg)
+    assert sc.sparse_transport
+    res = sc.run(rounds=1)
+    assert np.isfinite(res.final_accuracy)
+
+    dense = Scenario(
+        ScenarioConfig(
+            name="dense-fully", n_nodes=N, topology="fully",
+            data=DataConfig(dataset="mnist", samples_per_node=100),
+        )
+    )
+    assert not dense.sparse_transport  # fully-connected: all-gather wins
+
+
+def test_sparse_transport_rejects_cfl():
+    with pytest.raises(ValueError, match="sparse"):
+        from p2pfl_tpu.federation.scenario import Scenario
+
+        Scenario(
+            ScenarioConfig(
+                name="bad", n_nodes=N, topology="star", federation="CFL",
+                transport="sparse",
+                data=DataConfig(dataset="mnist", samples_per_node=100),
+            )
+        )

@@ -69,11 +69,14 @@ class ProtocolConfig:
     """
 
     aggregation_timeout_s: float = 60.0  # AGGREGATION_TIMEOUT
+    vote_timeout_s: float = 60.0  # VOTE_TIMEOUT (participant.json.example:70)
     heartbeat_period_s: float = 4.0  # HEARTBEAT_PERIOD
     node_timeout_s: float = 20.0  # NODE_TIMEOUT
     gossip_models_per_round: int = 2  # GOSSIP_MODELS_PER_ROUND
-    gossip_exit_on_equal_rounds: int = 20  # GOSSIP_EXIT_ON_X_EQUAL_ROUNDS
-    train_set_size: int = 10  # TRAIN_SET_SIZE
+    # GOSSIP_EXIT_ON_X_EQUAL_ROUNDS: quiet SECONDS before the gossip
+    # sender gives up (the reference counts ticks at 1 Hz — same unit)
+    gossip_exit_on_equal_rounds: int = 20
+    train_set_size: int = 10  # TRAIN_SET_SIZE; <=0 disables the cap
 
 
 @dataclasses.dataclass
@@ -122,6 +125,9 @@ class ScenarioConfig:
     # one node per device only); "auto" picks sparse when it is legal
     # and the topology is sparse enough to win
     transport: str = "auto"
+    # mutual TLS on the socket path (the reference's encrypter knob,
+    # base_node.py:62; scenario certs minted at launch)
+    encrypt: bool = False
     nodes: list[NodeConfig] = dataclasses.field(default_factory=list)
     faults: list[FaultEvent] = dataclasses.field(default_factory=list)
     seed: int = 0

@@ -45,7 +45,7 @@ class Aggregator:
     The reference's session bookkeeping (waiting for the train set,
     partial-aggregation gossip, contributor dedup —
     aggregator.py:106-229) lives in
-    :mod:`p2pfl_tpu.federation.gossip`, not here: this class is only
+    :mod:`p2pfl_tpu.p2p.session`, not here: this class is only
     the math, so it can run on-device.
     """
 

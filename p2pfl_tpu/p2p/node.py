@@ -30,6 +30,7 @@ import contextlib
 import dataclasses
 import logging
 import random
+import secrets
 from typing import Any
 
 from p2pfl_tpu.config.schema import ProtocolConfig
@@ -50,12 +51,23 @@ log = logging.getLogger("p2pfl_tpu.p2p")
 
 @dataclasses.dataclass
 class PeerState:
-    """Per-peer round-progress view (node_connection.py:275-335)."""
+    """One live connection (node_connection.py's socket half)."""
 
     idx: int
     writer: asyncio.StreamWriter
     reader_task: asyncio.Task | None = None
+
+
+@dataclasses.dataclass
+class NodeProgress:
+    """A node's round-progress as this node knows it
+    (node_connection.py:275-335's tracking, decoupled from the
+    connection: progress messages FLOOD, so state is known for every
+    federation member, not just direct peers — that is what lets a
+    gossiper reason about nodes it can only reach through a PROXY)."""
+
     models_aggregated: set[int] = dataclasses.field(default_factory=set)
+    agg_round: int = -1  # round the models_aggregated set belongs to
     initialized: bool = False
     ready_round: int = -1
 
@@ -77,6 +89,7 @@ class P2PNode:
         gossip_period_s: float = 0.05,
         federation: str = "DFL",
         seed: int = 0,
+        tls=None,
     ):
         from p2pfl_tpu.p2p.session import AggregationSession
 
@@ -90,12 +103,16 @@ class P2PNode:
         self.start_learning_flag = start_learning
         self.gossip_period_s = gossip_period_s
         self.federation = federation
+        # mutual TLS (p2pfl_tpu.p2p.tls.TLSCredentials) — replaces the
+        # reference's RSA/AES-ECB handshake (encrypter.py:48-193)
+        self.tls = tls
         self._rng = random.Random(seed * 7919 + idx)
         self.session = AggregationSession(
             aggregator, timeout_s=self.protocol.aggregation_timeout_s
         )
         self.membership = Membership(n_nodes, self.protocol, virtual=False)
         self.peers: dict[int, PeerState] = {}
+        self.progress: dict[int, NodeProgress] = {}
         self.peer_roles: dict[int, str] = {}
         # capacity scales with federation size: BEATs from every node
         # share this ring, and 100 ids evict before a flood quiesces
@@ -103,6 +120,10 @@ class P2PNode:
         self.dedup = DedupRing(capacity=max(100, 20 * n_nodes))
         self.round = 0
         self.total_rounds = 0
+        # train-set ballots: round -> voter -> candidate tuple
+        # (VOTE_TRAIN_SET flow, communication_protocol.py:47 +
+        # node.py:881-887 vote intake)
+        self._votes: dict[int, dict[int, tuple[int, ...]]] = {}
         self.epochs = 1
         self.initialized = False
         self.learning = False
@@ -126,7 +147,8 @@ class P2PNode:
     # ------------------------------------------------------------------
     async def start(self) -> None:
         self._server = await asyncio.start_server(
-            self._on_connection, self.host, self.port
+            self._on_connection, self.host, self.port,
+            ssl=self.tls.server_context() if self.tls else None,
         )
         self.port = self._server.sockets[0].getsockname()[1]
         self.membership.beat(self.idx, 0.0)
@@ -154,7 +176,10 @@ class P2PNode:
 
     async def connect_to(self, host: str, port: int) -> None:
         """Dial a neighbor (base_node.py connect_to)."""
-        reader, writer = await asyncio.open_connection(host, port)
+        reader, writer = await asyncio.open_connection(
+            host, port,
+            ssl=self.tls.client_context() if self.tls else None,
+        )
         await write_message(
             writer, Message(MsgType.CONNECT, self.idx, {"port": self.port})
         )
@@ -215,11 +240,19 @@ class P2PNode:
         elif t is MsgType.PARAMS:
             await self._on_params(peer, msg)
         elif t is MsgType.MODELS_AGGREGATED:
-            peer.models_aggregated = set(msg.body["contributors"])
+            pr = self._progress(msg.sender)
+            pr.models_aggregated = set(msg.body["contributors"])
+            pr.agg_round = int(msg.body.get("round", 0))
         elif t is MsgType.MODEL_INITIALIZED:
-            peer.initialized = True
+            self._progress(msg.sender).initialized = True
         elif t is MsgType.MODELS_READY:
-            peer.ready_round = int(msg.body["round"])
+            self._progress(msg.sender).ready_round = int(msg.body["round"])
+        elif t is MsgType.VOTE_TRAIN_SET:
+            r = int(msg.body["round"])
+            if r >= self.round:  # stale-round ballots are dead voters
+                self._votes.setdefault(r, {})[msg.sender] = tuple(
+                    int(c) for c in msg.body["candidates"]
+                )
         elif t is MsgType.TRANSFER_LEADERSHIP:
             self.leader = int(msg.body["to"])
             self.leader_history.append(self.leader)
@@ -239,6 +272,14 @@ class P2PNode:
                 # (node.py:702-724 diffusion-until-initialized)
                 asyncio.create_task(self._diffuse_initial())
             return
+        if self.role == "proxy" and msg.msg_id:
+            # PROXY: relay weight traffic onward so it bridges nodes
+            # with no direct link (node.py:492-515, 999-1017 — the
+            # reference stores and re-gossips on a timer; here the
+            # relay is immediate, deduped by msg_id so two proxies
+            # can't ping-pong the same message)
+            if self.dedup.check_and_add(msg.msg_id):
+                await self._forward(msg, exclude=peer.idx)
         # round fencing: a round-r model must never enter a round-r'
         # session (a stale full aggregate would instantly "cover" a
         # fresh session and erase this round's training). Messages for
@@ -264,7 +305,7 @@ class P2PNode:
             await self.broadcast(
                 Message(
                     MsgType.MODELS_AGGREGATED, self.idx,
-                    {"contributors": sorted(covered)},
+                    {"contributors": sorted(covered), "round": self.round},
                 )
             )
 
@@ -292,7 +333,10 @@ class P2PNode:
         try:
             await write_message(
                 peer.writer,
-                Message(MsgType.PARAMS, self.idx, body, payload=blob),
+                Message(MsgType.PARAMS, self.idx, body, payload=blob,
+                        # explicit id: PARAMS is a direct message, but
+                        # proxies relay it and need at-most-once dedup
+                        msg_id=secrets.token_hex(8)),
             )
         except (ConnectionError, RuntimeError):
             self.peers.pop(peer.idx, None)
@@ -348,9 +392,92 @@ class P2PNode:
             self._learn_task.cancel()
         self.finished.set()
 
+    def _progress(self, idx: int) -> NodeProgress:
+        if idx not in self.progress:
+            self.progress[idx] = NodeProgress()
+        return self.progress[idx]
+
+    def _aggregated_by(self, idx: int) -> set[int]:
+        """What node ``idx`` has aggregated THIS round (stale rounds
+        read as empty — the reference clears per-peer aggregation state
+        at round end, node.py:646)."""
+        pr = self.progress.get(idx)
+        if pr is None or pr.agg_round != self.round:
+            return set()
+        return pr.models_aggregated
+
     def _train_set(self) -> set[int]:
         alive = set(self.membership.get_nodes())
         return (alive & (set(self.peers) | {self.idx}))
+
+    def _trainable(self, nodes: set[int]) -> set[int]:
+        """Nodes that may carry training duty: proxies and idles are
+        never train-set candidates (they forward/adopt but don't
+        contribute — node.py:492-524)."""
+        out = set()
+        for i in nodes:
+            role = self.peer_roles.get(i) if i != self.idx else self.role
+            if role not in ("proxy", "idle"):
+                out.add(i)
+        return out
+
+    async def _vote_train_set(self) -> set[int]:
+        """Elect this round's train set (node.py:537-630 vote flow,
+        VOTE_TIMEOUT + TRAIN_SET_SIZE knobs, participant.json.example:70).
+
+        Every node's ballot is the trainable part of its own live
+        neighborhood (itself + direct peers it believes alive) — the
+        nodes it can vouch for. Ballots flood the overlay; the tally
+        elects the ``train_set_size`` best-vouched-for candidates with
+        index tie-break, so every node computes the same winners from
+        the same ballots. Dead voters (evicted by membership) are
+        dropped from the tally; missing ballots stop blocking after
+        ``vote_timeout_s``.
+        """
+        loop = asyncio.get_event_loop()
+        alive = set(self.membership.get_nodes())
+        ballot = sorted(
+            self._trainable(alive & (set(self.peers) | {self.idx}))
+        )
+        votes = self._votes.setdefault(self.round, {})
+        votes[self.idx] = tuple(ballot)
+        await self.broadcast(
+            Message(MsgType.VOTE_TRAIN_SET, self.idx,
+                    {"round": self.round, "candidates": ballot})
+        )
+        deadline = loop.time() + self.protocol.vote_timeout_s
+        while loop.time() < deadline:
+            alive = set(self.membership.get_nodes())
+            if alive <= set(votes):
+                break  # every live node's ballot arrived
+            await asyncio.sleep(self.gossip_period_s)
+        tally: dict[int, int] = {}
+        for voter, cands in votes.items():
+            if voter in alive:  # dead voters dropped (node.py:537-548)
+                for c in cands:
+                    tally[c] = tally.get(c, 0) + 1
+        k = self.protocol.train_set_size
+        if k <= 0 or k > len(tally):
+            k = len(tally)
+        # tie-break ROTATES with the round so a binding cap still
+        # covers every node's data over time (the reference's vote
+        # uses random weights for the same effect, node.py:573-598);
+        # round number is barrier-agreed, so all nodes elect the same set
+        winners = sorted(
+            tally,
+            key=lambda c: (-tally[c], (c - self.round) % self.n_nodes),
+        )[:k]
+        win = set(winners) or {self.idx}
+        # the leader must aggregate, so it is always seated (CFL server /
+        # SDFL token holder); it displaces the weakest winner
+        if (self.leader is not None and self.leader in alive
+                and self.leader not in win):
+            if winners and len(win) >= k:
+                win.discard(winners[-1])
+            win.add(self.leader)
+        # ballots for finished rounds are garbage; future ones are kept
+        self._votes = {r: v for r, v in self._votes.items() if r > self.round}
+        return win
 
     async def _learning_loop(self) -> None:
         ln = self.learner
@@ -372,11 +499,11 @@ class P2PNode:
         params = self.learner.get_parameters()
         deadline = asyncio.get_event_loop().time() + self.protocol.aggregation_timeout_s
         while (
-            any(not p.initialized for p in self.peers.values())
+            any(not self._progress(i).initialized for i in self.peers)
             and asyncio.get_event_loop().time() < deadline
         ):
-            for peer in list(self.peers.values()):
-                if not peer.initialized:
+            for idx, peer in list(self.peers.items()):
+                if not self._progress(idx).initialized:
                     await self._send_params(peer, params, (), 1, init=True)
             await asyncio.sleep(self.gossip_period_s)
 
@@ -396,7 +523,7 @@ class P2PNode:
         )
 
     async def _train_round(self) -> None:
-        train_set = self._train_set()
+        train_set = await self._vote_train_set()
         self.session.clear()
         # Snapshot the effective role and token position for the WHOLE
         # round: a TRANSFER_LEADERSHIP that lands mid-round must not
@@ -405,6 +532,10 @@ class P2PNode:
         # in one round.
         role = self._effective_role()
         leader_at_start = self.leader
+        if self.idx not in train_set and role in ("aggregator", "trainer"):
+            # voted out this round: no training duty, adopt only
+            # (the reference's is-in-train-set gate, node.py:425-427)
+            role = "idle"
         # session mode is set BEFORE fit (which runs in an executor)
         # and BEFORE replaying buffered messages: an aggregate arriving
         # mid-fit or buffered from a fast peer must be adopted by a
@@ -428,7 +559,8 @@ class P2PNode:
             )
             await self.broadcast(
                 Message(MsgType.MODELS_AGGREGATED, self.idx,
-                        {"contributors": sorted(covered)})
+                        {"contributors": sorted(covered),
+                         "round": self.round})
             )
             await self._gossip_until_done(train_set, role, leader_at_start)
         elif role == "trainer":
@@ -465,7 +597,9 @@ class P2PNode:
             # round completion (and exit its round barrier) without
             # having the new token — the next round always starts with
             # exactly one leader everywhere.
-            candidates = sorted(self._train_set() - {self.idx})
+            candidates = sorted(
+                (train_set & set(self.membership.get_nodes())) - {self.idx}
+            )
             if candidates:
                 new_leader = self._rng.choice(candidates)
                 self.leader = new_leader
@@ -488,28 +622,91 @@ class P2PNode:
         ``leader_at_start`` are the caller's round-start snapshot — the
         live token may have moved mid-round."""
         fanout = max(self.protocol.gossip_models_per_round, 1)
-        while not self.session.check_and_run():
-            candidates = [
-                p for i, p in self.peers.items()
-                if i in train_set
-                and self.peer_roles.get(i, "aggregator")
+        loop = asyncio.get_event_loop()
+        last_status = None
+        last_change_t = loop.time()
+        deadline = loop.time() + self.session.timeout_s
+        # who is expected to AGGREGATE this round: in CFL/SDFL only the
+        # round's leader fuses models (trainers adopt its offer — they
+        # will never show coverage themselves, so waiting on them would
+        # deadlock until timeout); in DFL every train-set node with an
+        # aggregating role does (the reference's split between
+        # aggregation-gossip and diffusion, node.py:692-724)
+        if self.federation in ("CFL", "SDFL"):
+            aggregators = (
+                {leader_at_start} if leader_at_start is not None else set()
+            )
+        else:
+            aggregators = {
+                i for i in train_set
+                if self.peer_roles.get(i, "aggregator")
                 in ("aggregator", "server")
-                and not (self.session.covered <= p.models_aggregated)
+            }
+        while True:
+            done = self.session.check_and_run()
+            proxies = [
+                p for i, p in self.peers.items()
+                if self.peer_roles.get(i) == "proxy"
             ]
-            random.shuffle(candidates)
-            for peer in candidates[:fanout]:
-                partial = self.session.get_partial_aggregation(
-                    peer.models_aggregated
-                )
+            # target = an aggregating NODE that hasn't covered the
+            # WHOLE train set yet (node.py:695 candidate condition) —
+            # gossip continues even after our own session completes,
+            # or a node whose session fills up early (it received
+            # everyone during its fit) would never ship its own model.
+            # Progress floods, so this covers nodes reachable only
+            # through a PROXY — but only REACHABLE targets may consume
+            # fanout slots (building a partial for an undeliverable
+            # node would waste both the aggregation and the slot).
+            targets = [
+                (i, self._aggregated_by(i))
+                for i in sorted(aggregators - {self.idx})
+                if not (train_set <= self._aggregated_by(i))
+                and (i in self.peers or proxies)
+            ]
+            if (done and not targets) or loop.time() > deadline:
+                break
+            random.shuffle(targets)
+            for i, has in targets[:fanout]:
+                partial = self.session.get_partial_aggregation(has)
                 if partial is None:
                     continue
                 params, contribs, weight = partial
-                await self._send_params(peer, params, contribs, weight)
+                if i in self.peers:
+                    await self._send_params(
+                        self.peers[i], params, contribs, weight
+                    )
+                else:
+                    # no direct link: hand the partial to proxies to
+                    # relay (node.py:492-515)
+                    for peer in proxies:
+                        await self._send_params(peer, params, contribs,
+                                                weight)
+            # convergence exit (node.py:761-777, GOSSIP_EXIT_ON_X_EQUAL_
+            # ROUNDS): the reference's gossip tick is 1 Hz, so "20
+            # equal rounds" means ~20 quiet SECONDS — measure quiet
+            # time by wall clock so fast tick rates don't turn the knob
+            # into a hair trigger. On exit, stop SENDING only: the
+            # reference exits just its gossip loop; aggregation still
+            # completes by coverage or timeout (aggregator.py:46-76).
+            status = (
+                self.session.covered,
+                tuple((i, tuple(sorted(has))) for i, has in sorted(targets)),
+            )
+            now = loop.time()
+            if status != last_status:
+                last_status, last_change_t = status, now
+            if (self.protocol.gossip_exit_on_equal_rounds > 0
+                    and now - last_change_t
+                    >= self.protocol.gossip_exit_on_equal_rounds):
+                while not self.session.check_and_run():
+                    await asyncio.sleep(self.gossip_period_s)
+                break
             await asyncio.sleep(self.gossip_period_s)
         # aggregation finished; if a full aggregate exists, also offer it
         # to trainer/idle peers waiting for one (CFL/SDFL broadcast)
-        if role == "server" or (
-            leader_at_start == self.idx and role == "aggregator"
+        if self.session.result is not None and (
+            role == "server"
+            or (leader_at_start == self.idx and role == "aggregator")
         ):
             params, contribs = self.session.result
             for peer in list(self.peers.values()):
@@ -526,14 +723,17 @@ class P2PNode:
             await asyncio.sleep(self.gossip_period_s)
 
     async def _wait_neighbors_ready(self) -> None:
-        """Round barrier: wait until alive neighbors report this round
-        (MODELS_READY gating, node.py:713), bounded by the timeout."""
+        """Round barrier: wait until every alive node we've heard from
+        reports this round (MODELS_READY gating, node.py:713; floods,
+        so multi-hop members count too), bounded by the timeout."""
         deadline = asyncio.get_event_loop().time() + self.session.timeout_s
         while asyncio.get_event_loop().time() < deadline:
             alive = set(self.membership.get_nodes())
+            known = set(self.peers) | set(self.progress)
             behind = [
-                p for i, p in self.peers.items()
-                if i in alive and p.ready_round < self.round
+                i for i in alive & known
+                if i != self.idx
+                and self._progress(i).ready_round < self.round
             ]
             if not behind:
                 return

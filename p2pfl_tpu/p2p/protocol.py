@@ -38,16 +38,18 @@ class MsgType(enum.Enum):
     STOP_LEARNING = "stop_learning"
     VOTE_TRAIN_SET = "vote_train_set"
     METRICS = "metrics"
-    # transfer rides the gossip flood: on multi-hop overlays every node
-    # must learn the new token, not just the old leader's direct peers
+    # these ride the gossip flood: on multi-hop overlays (and through
+    # PROXY relays) every node needs the leadership token and every
+    # node's round-progress state, not just direct peers' — the
+    # reference gets the same effect from its full-mesh assumption
     TRANSFER_LEADERSHIP = "transfer_leadership"
+    MODELS_READY = "models_ready"
+    MODELS_AGGREGATED = "models_aggregated"
+    MODEL_INITIALIZED = "model_initialized"
     # direct messages
     CONNECT = "connect"
     STOP = "stop"
     PARAMS = "params"
-    MODELS_READY = "models_ready"
-    MODELS_AGGREGATED = "models_aggregated"
-    MODEL_INITIALIZED = "model_initialized"
 
 
 GOSSIPED = frozenset(
@@ -59,6 +61,9 @@ GOSSIPED = frozenset(
         MsgType.VOTE_TRAIN_SET,
         MsgType.METRICS,
         MsgType.TRANSFER_LEADERSHIP,
+        MsgType.MODELS_READY,
+        MsgType.MODELS_AGGREGATED,
+        MsgType.MODEL_INITIALIZED,
     }
 )
 

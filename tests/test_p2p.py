@@ -121,7 +121,8 @@ def _make_learners(n, samples=150):
     return fed, learners
 
 
-_PROTO = ProtocolConfig(heartbeat_period_s=0.2, aggregation_timeout_s=20.0)
+_PROTO = ProtocolConfig(heartbeat_period_s=0.2, aggregation_timeout_s=20.0,
+                        vote_timeout_s=5.0)
 
 
 async def _run_federation(roles, rounds=2, start_node=0):
@@ -234,6 +235,99 @@ def test_sdfl_socket_federation_rotates():
             assert len({node.leader for node in nodes}) == 1
             # rotated leaders (static role "trainer") must still have
             # broadcast the finished aggregate: everyone agrees
+            k0 = np.asarray(
+                nodes[0].learner.get_parameters()["params"]["Dense_0"]["kernel"]
+            )
+            k2 = np.asarray(
+                nodes[2].learner.get_parameters()["params"]["Dense_0"]["kernel"]
+            )
+            np.testing.assert_allclose(k0, k2, rtol=1e-4, atol=1e-5)
+        finally:
+            for node in nodes:
+                await node.stop()
+
+    asyncio.run(main())
+
+
+def test_train_set_vote_caps_participants():
+    """TRAIN_SET_SIZE binds: 5 nodes, cap 3 — the vote seats exactly
+    three trainers; voted-out nodes adopt the aggregate
+    (VOTE_TRAIN_SET flow + TRAIN_SET_SIZE, participant.json.example:70)."""
+
+    async def main():
+        n = 5
+        proto = ProtocolConfig(heartbeat_period_s=0.2,
+                               aggregation_timeout_s=20.0,
+                               vote_timeout_s=5.0, train_set_size=3)
+        fed, learners = _make_learners(n)
+        nodes = [
+            P2PNode(i, learners[i], role="aggregator", n_nodes=n,
+                    protocol=proto, gossip_period_s=0.02)
+            for i in range(n)
+        ]
+        for node in nodes:
+            await node.start()
+        for i in range(n):
+            for j in range(i + 1, n):
+                await nodes[i].connect_to(nodes[j].host, nodes[j].port)
+        nodes[0].learner.init()
+        nodes[0].set_start_learning(rounds=1, epochs=1)
+        try:
+            await asyncio.wait_for(
+                asyncio.gather(*(node.finished.wait() for node in nodes)),
+                timeout=120,
+            )
+            assert all(node.round == 1 for node in nodes)
+            # fully connected, equal vouching: the tie-break elects the
+            # three lowest indices; the last round's session still holds
+            # the coverage
+            assert nodes[0].session.covered == frozenset({0, 1, 2})
+            # voted-out nodes adopted the seated nodes' aggregate
+            k0 = np.asarray(
+                nodes[0].learner.get_parameters()["params"]["Dense_0"]["kernel"]
+            )
+            k4 = np.asarray(
+                nodes[4].learner.get_parameters()["params"]["Dense_0"]["kernel"]
+            )
+            np.testing.assert_allclose(k0, k4, rtol=1e-4, atol=1e-5)
+        finally:
+            for node in nodes:
+                await node.stop()
+
+    asyncio.run(main())
+
+
+def test_proxy_bridges_disconnected_trainers():
+    """A proxy relays weight traffic between two nodes with no direct
+    link (node.py:492-515, 999-1017): chain 0 - proxy - 2, and the
+    two end nodes still reach full coverage and converge."""
+
+    async def main():
+        n = 3
+        fed, learners = _make_learners(n)
+        roles = ["aggregator", "proxy", "aggregator"]
+        nodes = [
+            P2PNode(i, learners[i], role=roles[i], n_nodes=n,
+                    protocol=_PROTO, gossip_period_s=0.02)
+            for i in range(n)
+        ]
+        for node in nodes:
+            await node.start()
+        await nodes[0].connect_to(nodes[1].host, nodes[1].port)
+        await nodes[1].connect_to(nodes[2].host, nodes[2].port)
+        nodes[0].learner.init()
+        nodes[0].set_start_learning(rounds=1, epochs=1)
+        try:
+            await asyncio.wait_for(
+                asyncio.gather(*(node.finished.wait() for node in nodes)),
+                timeout=120,
+            )
+            assert all(node.round == 1 for node in nodes)
+            # both end nodes aggregated BOTH contributions — only
+            # possible via the proxy relay — and the proxy itself
+            # never contributed
+            assert nodes[0].session.covered == frozenset({0, 2})
+            assert nodes[2].session.covered == frozenset({0, 2})
             k0 = np.asarray(
                 nodes[0].learner.get_parameters()["params"]["Dense_0"]["kernel"]
             )

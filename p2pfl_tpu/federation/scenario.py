@@ -51,6 +51,7 @@ from p2pfl_tpu.parallel.federated import (
 from p2pfl_tpu.parallel.transport import MeshTransport, edge_offsets
 from p2pfl_tpu.topology.topology import generate_topology
 from p2pfl_tpu.utils.metrics import MetricsLogger
+from p2pfl_tpu.utils.monitor import publish_status
 from p2pfl_tpu.utils.telemetry import resource_snapshot
 
 
@@ -140,7 +141,7 @@ class Scenario(Observable):
         self.global_step = (
             int(np.asarray(self.fed.round)) * self._steps_per_round
         )
-        self._plan_cache: dict[int, tuple] = {}
+        self._plan_cache: dict[tuple, tuple] = {}
 
     # ------------------------------------------------------------------
     def _choose_sparse(self) -> bool:
@@ -224,22 +225,90 @@ class Scenario(Observable):
             if len(alive_idx):
                 self.leader = int(alive_idx[0])
 
-    def _plan_args(self):
+    def _voted_trains(self, alive: np.ndarray,
+                      round_num: int = 0) -> np.ndarray | None:
+        """Train-set vote, collapsed to its deterministic fixed point.
+
+        The socket path floods per-node ballots (each node vouches for
+        the trainable part of its live neighborhood) and elects the
+        ``train_set_size`` best-vouched candidates. On the host every
+        voter sees the same alive set, so the tally is computable
+        directly: score[j] = #alive nodes adjacent to j (plus j
+        itself), with a round-ROTATING index tie-break so a binding cap
+        still covers every node's data over rounds. Returns None when
+        the cap doesn't bind (the plan's static ``trains`` stands).
+        """
+        k = self.config.protocol.train_set_size
+        n = self.config.n_nodes
+        eligible = [
+            i for i in np.flatnonzero(alive)
+            if self.roles[i] in ("trainer", "aggregator", "server")
+        ]
+        if k <= 0 or k >= len(eligible):
+            return None
+        adj = self.topology.adjacency
+        score = {
+            j: 1 + int(np.sum(adj[np.flatnonzero(alive), j]))
+            for j in eligible
+        }
+        winners = sorted(
+            score, key=lambda j: (-score[j], (j - round_num) % n)
+        )[:k]
+        win = set(winners)
+        if self.config.federation in ("CFL", "SDFL") and alive[self.leader]:
+            if self.leader not in win:
+                win.discard(winners[-1])
+                win.add(self.leader)
+        trains = np.zeros(self.config.n_nodes, bool)
+        trains[sorted(win)] = True
+        return trains
+
+    def _plan_args(self, trains_override: np.ndarray | None = None):
         """Device arrays for the current round plan. Liveness is folded
         in on-device from ``fed.alive``, so the plan depends only on the
-        leader — cache per leader to avoid per-round host→device
-        transfers."""
-        if self.leader not in self._plan_cache:
+        leader and the voted train set — cached to avoid per-round
+        host→device transfers."""
+        key = (
+            self.leader,
+            None if trains_override is None else trains_override.tobytes(),
+        )
+        if key not in self._plan_cache:
             plan = make_round_plan(
                 self.topology, self.roles, self.config.federation, self.leader
             )
+            trains = plan.trains if trains_override is None else trains_override
             tr = self.transport
-            self._plan_cache[self.leader] = (
+            self._plan_cache[key] = (
                 tr.put_stacked(jnp.asarray(plan.mix)),
                 tr.put_stacked(jnp.asarray(plan.adopt)),
-                tr.put_stacked(jnp.asarray(plan.trains)),
+                tr.put_stacked(jnp.asarray(trains)),
             )
-        return self._plan_cache[self.leader]
+        return self._plan_cache[key]
+
+    def _publish_statuses(self, r: int, alive: np.ndarray,
+                          train_loss: np.ndarray, ev: dict | None) -> None:
+        """Per-node live status for ``python -m p2pfl_tpu.monitor``
+        (the node→controller heartbeat POST analog, node.py:916-937)."""
+        if self.logger.dir is None:
+            return
+        status_dir = self.logger.dir / "status"
+        n_alive = int(alive.sum())
+        for i in range(self.config.n_nodes):
+            if not alive[i]:
+                continue  # dead nodes go silent, like a crashed process
+            publish_status(
+                status_dir, i,
+                {
+                    "role": self.roles[i],
+                    "round": r + 1,
+                    "loss": float(train_loss[i]),
+                    "accuracy": (
+                        float(ev["per_node_accuracy"][i]) if ev else None
+                    ),
+                    "peers": n_alive - 1,
+                    "leader": self.leader,
+                },
+            )
 
     def evaluate(self) -> dict[str, Any]:
         metrics = self._eval_fn(self.fed, self._x_test, self._y_test)
@@ -272,7 +341,8 @@ class Scenario(Observable):
                 alive=self.transport.put_stacked(jnp.asarray(alive))
             )
             self.fed, metrics = self._round_fn(
-                self.fed, *self._data_args, *self._plan_args()
+                self.fed, *self._data_args,
+                *self._plan_args(self._voted_trains(alive, r)),
             )
             jax.block_until_ready(self.fed.states.params)
             self.notify(Events.AGGREGATION_FINISHED, {"round": r})
@@ -287,6 +357,7 @@ class Scenario(Observable):
                      "Train/round_time_s": dt},
                     step=self.global_step, round=r, node=i,
                 )
+            self._publish_statuses(r, alive, train_loss, ev)
             if cfg.training.eval_every and (r + 1) % cfg.training.eval_every == 0:
                 ev = self.evaluate()
                 ev_round = r

@@ -1,0 +1,36 @@
+"""``python -m p2pfl_tpu.monitor <status-dir>`` — live federation view.
+
+The terminal/HTML successor of the reference's Flask monitoring page
+(webserver/app.py:291-364). Point it at a running scenario's status
+directory (``<log_dir>/<name>/status``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from p2pfl_tpu.utils.monitor import DEFAULT_LIVENESS_S, watch
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(prog="p2pfl_tpu.monitor")
+    ap.add_argument("status_dir", help="scenario status directory")
+    ap.add_argument("--interval", type=float, default=1.0)
+    ap.add_argument("--html", default=None,
+                    help="also write a self-refreshing dashboard page here")
+    ap.add_argument("--once", action="store_true",
+                    help="print one snapshot and exit")
+    ap.add_argument("--liveness", type=float, default=DEFAULT_LIVENESS_S,
+                    help="seconds before a silent node renders as DEAD")
+    args = ap.parse_args(argv)
+    try:
+        watch(args.status_dir, interval_s=args.interval, html_out=args.html,
+              once=args.once, liveness_s=args.liveness)
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

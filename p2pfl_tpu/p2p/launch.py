@@ -46,9 +46,15 @@ def _free_ports(n: int) -> list[int]:
     return ports
 
 
-async def _run_node(cfg: ScenarioConfig, idx: int, ports: list[int]) -> dict:
+async def _run_node(cfg: ScenarioConfig, idx: int, ports: list[int],
+                    tls_dir: str | None = None) -> dict:
     """One node's full lifecycle (node_start.py main analog)."""
     n = cfg.n_nodes
+    tls = None
+    if tls_dir:
+        from p2pfl_tpu.p2p.tls import load_node_credentials
+
+        tls = load_node_credentials(tls_dir, idx)
     data = FederatedDataset.make(cfg.data, n)  # deterministic: same shards
     learner = JaxLearner(
         model=get_model(cfg.model.model, **cfg.model.kwargs),
@@ -71,6 +77,7 @@ async def _run_node(cfg: ScenarioConfig, idx: int, ports: list[int]) -> dict:
         protocol=cfg.protocol,
         federation=cfg.federation,
         seed=cfg.seed,
+        tls=tls,
     )
     await node.start()
     topo = generate_topology(cfg.topology, n, **cfg.topology_kwargs)
@@ -94,19 +101,46 @@ async def _run_node(cfg: ScenarioConfig, idx: int, ports: list[int]) -> dict:
     deadline = time.monotonic() + 60
     while not want <= set(node.peers) and time.monotonic() < deadline:
         await asyncio.sleep(0.1)
+    status_task = None
+    if cfg.log_dir:
+        from p2pfl_tpu.utils.monitor import publish_status
+
+        status_dir = pathlib.Path(cfg.log_dir) / cfg.name / "status"
+
+        async def _publish_loop():
+            # the reference's REPORT_STATUS_TO_CONTROLLER heartbeat
+            # cycle (node.py:916-937, heartbeater.py:75-78)
+            while True:
+                publish_status(
+                    status_dir, idx,
+                    {"role": node.role, "round": node.round,
+                     "peers": len(node.peers),
+                     "leader": node.leader},
+                )
+                await asyncio.sleep(cfg.protocol.heartbeat_period_s)
+
+        status_task = asyncio.get_event_loop().create_task(_publish_loop())
     if cfg.nodes[idx].start:
         learner.init()
         node.set_start_learning(cfg.training.rounds,
                                 cfg.training.epochs_per_round)
     await asyncio.wait_for(node.finished.wait(), timeout=600)
     metrics = learner.evaluate()
+    if status_task is not None:
+        status_task.cancel()
+        publish_status(
+            status_dir, idx,
+            {"role": node.role, "round": node.round,
+             "peers": len(node.peers), "leader": node.leader, **metrics},
+        )
     await node.stop()
     return {"node": idx, "round": node.round, **metrics}
 
 
-def node_main(config_path: str, idx: int, ports: list[int]) -> None:
+def node_main(config_path: str, idx: int, ports: list[int],
+              tls_dir: str | None = None) -> None:
     cfg = ScenarioConfig.load(config_path)
-    result = asyncio.run(_run_node(cfg, idx, ports))
+    result = asyncio.run(_run_node(cfg, idx, ports, tls_dir=tls_dir))
     print("P2PFL_RESULT " + json.dumps(result), flush=True)
 
 
@@ -118,8 +152,19 @@ def launch(cfg: ScenarioConfig, config_path: str | pathlib.Path,
     processes cannot share one TPU chip, so multi-process mode on a
     single-chip host runs compute on CPU (on a pod each host pins its
     own chips).
+
+    With ``cfg.encrypt`` the parent mints a scenario CA + per-node
+    certificates next to the config file and every connection runs
+    mutual TLS (controller-stamps-credentials analog of the
+    reference's encrypter wiring, base_node.py:246-256).
     """
     ports = _free_ports(cfg.n_nodes)
+    tls_dir = None
+    if cfg.encrypt:
+        from p2pfl_tpu.p2p.tls import make_scenario_credentials
+
+        tls_dir = str(pathlib.Path(config_path).resolve().parent / "tls")
+        make_scenario_credentials(tls_dir, cfg.n_nodes, name=cfg.name)
     procs = []
     for i in range(cfg.n_nodes):
         cmd = [sys.executable, "-m", "p2pfl_tpu.p2p.launch",
@@ -127,6 +172,8 @@ def launch(cfg: ScenarioConfig, config_path: str | pathlib.Path,
                "--ports", ",".join(map(str, ports))]
         if platform:
             cmd += ["--platform", platform]
+        if tls_dir:
+            cmd += ["--tls-dir", tls_dir]
         procs.append(
             subprocess.Popen(cmd, stdout=subprocess.PIPE,
                              stderr=subprocess.STDOUT, text=True)
@@ -149,6 +196,8 @@ def main(argv: list[str] | None = None) -> int:
                     help="comma-separated port per node (child mode)")
     ap.add_argument("--platform", default=None,
                     help="force a JAX platform (e.g. cpu) in children")
+    ap.add_argument("--tls-dir", default=None,
+                    help="directory with scenario TLS material (child mode)")
     args = ap.parse_args(argv)
     if args.platform:
         import jax
@@ -156,7 +205,8 @@ def main(argv: list[str] | None = None) -> int:
         jax.config.update("jax_platforms", args.platform)
     if args.node is not None:
         node_main(args.config, args.node,
-                  [int(p) for p in args.ports.split(",")])
+                  [int(p) for p in args.ports.split(",")],
+                  tls_dir=args.tls_dir)
         return 0
     cfg = ScenarioConfig.load(args.config)
     results = launch(cfg, args.config, platform=args.platform)

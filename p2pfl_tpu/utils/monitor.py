@@ -1,0 +1,154 @@
+"""Live federation monitoring — the L5 successor.
+
+Reference: every node POSTs its status to the controller each heartbeat
+cycle (node.py:916-937); the controller upserts a SQLite ``nodes``
+table (webserver/database.py:253-274); the Flask monitoring page
+renders a live node table/map with a 20 s liveness cutoff
+(webserver/app.py:291-364, :307-311).
+
+Here the transport is the filesystem (no service dependency, works for
+in-process scenarios AND multi-process socket federations): each
+participant atomically publishes ``node_<idx>.status.json`` into a
+status directory; ``python -m p2pfl_tpu.monitor <dir>`` renders a live
+terminal table (or ``--html`` writes a self-refreshing dashboard
+page). Liveness is record age against the same 20 s default.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import html
+import json
+import os
+import pathlib
+import sys
+import time
+from typing import Any
+
+DEFAULT_LIVENESS_S = 20.0  # webserver/app.py:307-311 cutoff
+
+
+def publish_status(directory: str | pathlib.Path, node: int,
+                   record: dict[str, Any]) -> pathlib.Path:
+    """Atomically publish one node's current status record."""
+    directory = pathlib.Path(directory)
+    directory.mkdir(parents=True, exist_ok=True)
+    rec = {"node": int(node), "ts": time.time(), **record}
+    path = directory / f"node_{node}.status.json"
+    tmp = path.with_suffix(".json.tmp")
+    tmp.write_text(json.dumps(rec))
+    os.replace(tmp, path)
+    return path
+
+
+def read_statuses(directory: str | pathlib.Path) -> list[dict[str, Any]]:
+    """All published node records, sorted by node index; unreadable
+    files (mid-replace on exotic filesystems) are skipped."""
+    directory = pathlib.Path(directory)
+    out = []
+    for path in sorted(directory.glob("node_*.status.json")):
+        try:
+            out.append(json.loads(path.read_text()))
+        except (ValueError, OSError):
+            continue
+    return sorted(out, key=lambda r: r.get("node", 0))
+
+
+_COLUMNS = ("node", "role", "round", "loss", "accuracy", "peers", "age")
+
+
+def _row(rec: dict[str, Any], now: float, liveness_s: float) -> dict[str, str]:
+    age = now - float(rec.get("ts", 0.0))
+    alive = age <= liveness_s
+
+    def num(key):
+        v = rec.get(key)
+        return "-" if v is None else (f"{v:.4f}" if isinstance(v, float) else str(v))
+
+    return {
+        "node": str(rec.get("node", "?")),
+        "role": str(rec.get("role", "-")),
+        "round": num("round"),
+        "loss": num("loss"),
+        "accuracy": num("accuracy"),
+        "peers": num("peers"),
+        "age": f"{age:.1f}s" + ("" if alive else " DEAD"),
+    }
+
+
+def render_table(statuses: list[dict[str, Any]], now: float | None = None,
+                 liveness_s: float = DEFAULT_LIVENESS_S) -> str:
+    """Plain-text node table (the monitoring page's table, app.py:291+)."""
+    now = time.time() if now is None else now
+    rows = [_row(r, now, liveness_s) for r in statuses]
+    widths = {
+        c: max(len(c), *(len(r[c]) for r in rows)) if rows else len(c)
+        for c in _COLUMNS
+    }
+    header = "  ".join(c.upper().ljust(widths[c]) for c in _COLUMNS)
+    lines = [header, "-" * len(header)]
+    for r in rows:
+        lines.append("  ".join(r[c].ljust(widths[c]) for c in _COLUMNS))
+    return "\n".join(lines)
+
+
+def render_html(statuses: list[dict[str, Any]], now: float | None = None,
+                liveness_s: float = DEFAULT_LIVENESS_S,
+                refresh_s: int = 2) -> str:
+    """Self-contained dashboard page (auto-refreshes via meta tag —
+    re-render it in a loop with --watch for a live view)."""
+    now = time.time() if now is None else now
+    rows = [_row(r, now, liveness_s) for r in statuses]
+    body = "".join(
+        "<tr class='{cls}'>{cells}</tr>".format(
+            cls="dead" if "DEAD" in r["age"] else "alive",
+            cells="".join(f"<td>{html.escape(r[c])}</td>" for c in _COLUMNS),
+        )
+        for r in rows
+    )
+    head = "".join(f"<th>{c.upper()}</th>" for c in _COLUMNS)
+    return f"""<!doctype html><html><head>
+<meta http-equiv="refresh" content="{refresh_s}">
+<title>p2pfl_tpu federation</title>
+<style>
+body{{font-family:monospace;background:#111;color:#ddd;padding:1em}}
+table{{border-collapse:collapse}} td,th{{padding:.3em .8em;border:1px solid #333}}
+tr.dead td{{color:#f55}} th{{background:#222}}
+</style></head><body>
+<h2>federation status — {time.strftime('%H:%M:%S', time.localtime(now))}</h2>
+<table><tr>{head}</tr>{body}</table>
+</body></html>"""
+
+
+@dataclasses.dataclass
+class StatusPublisher:
+    """A participant's handle for publishing its status each round /
+    heartbeat (the node→controller POST analog, node.py:916-937)."""
+
+    directory: pathlib.Path
+    node: int
+
+    def publish(self, **record: Any) -> None:
+        publish_status(self.directory, self.node, record)
+
+
+def watch(directory: str | pathlib.Path, interval_s: float = 1.0,
+          html_out: str | None = None, once: bool = False,
+          liveness_s: float = DEFAULT_LIVENESS_S) -> None:
+    """Render the live table to the terminal (and optionally an HTML
+    dashboard file) until interrupted."""
+    directory = pathlib.Path(directory)
+    while True:
+        statuses = read_statuses(directory)
+        table = render_table(statuses, liveness_s=liveness_s)
+        if html_out:
+            out = pathlib.Path(html_out)
+            tmp = out.with_suffix(out.suffix + ".tmp")
+            tmp.write_text(render_html(statuses, liveness_s=liveness_s))
+            os.replace(tmp, out)
+        if once:
+            print(table)
+            return
+        sys.stdout.write("\x1b[2J\x1b[H" + table + "\n")
+        sys.stdout.flush()
+        time.sleep(interval_s)

@@ -121,6 +121,28 @@ class TestScenario:
         s.run()
         assert transfers, "leadership never rotated in 4 SDFL rounds"
 
+    def test_voted_train_set_caps_and_seats_leader(self):
+        # star CFL, cap 3: the hub out-vouches every leaf; the vote
+        # elects {hub, leaf, leaf} and the leader stays seated
+        cfg = _cfg(
+            federation="CFL", topology="star", n_nodes=6,
+            protocol=ProtocolConfig(train_set_size=3),
+            training=TrainingConfig(rounds=1, epochs_per_round=1,
+                                    learning_rate=0.05),
+        )
+        s = Scenario(cfg)
+        trains = s._voted_trains(np.ones(6, bool))
+        assert trains is not None
+        assert trains[0]  # the CFL server is always seated
+        assert trains.sum() == 3
+        np.testing.assert_array_equal(np.flatnonzero(trains), [0, 1, 2])
+        # the cap not binding -> static plan stands
+        s2 = Scenario(_cfg(n_nodes=4))
+        assert s2._voted_trains(np.ones(4, bool)) is None
+        # a capped run still learns and every node adopts the aggregate
+        res = s.run()
+        assert res.final_accuracy > 0.3
+
     def test_cfl_server_failover(self):
         cfg = _cfg(
             federation="CFL", topology="star",

@@ -1,0 +1,72 @@
+"""Live monitoring: status publish/read, liveness, table/HTML render,
+and the scenario wiring (the reference's node->controller status loop +
+monitoring page, node.py:916-937 / webserver/app.py:291-364)."""
+
+import json
+import time
+
+from p2pfl_tpu.config.schema import DataConfig, ScenarioConfig, TrainingConfig
+from p2pfl_tpu.utils.monitor import (
+    publish_status,
+    read_statuses,
+    render_html,
+    render_table,
+)
+
+
+def test_publish_read_roundtrip(tmp_path):
+    publish_status(tmp_path, 1, {"role": "trainer", "round": 3, "loss": 0.5})
+    publish_status(tmp_path, 0, {"role": "aggregator", "round": 3})
+    recs = read_statuses(tmp_path)
+    assert [r["node"] for r in recs] == [0, 1]
+    assert recs[1]["loss"] == 0.5
+    # republish overwrites atomically (no partial files left behind)
+    publish_status(tmp_path, 1, {"role": "trainer", "round": 4})
+    recs = read_statuses(tmp_path)
+    assert len(recs) == 2 and recs[1]["round"] == 4
+    assert not list(tmp_path.glob("*.tmp"))
+
+
+def test_render_liveness(tmp_path):
+    publish_status(tmp_path, 0, {"role": "aggregator", "round": 1})
+    path = publish_status(tmp_path, 1, {"role": "trainer", "round": 1})
+    stale = json.loads(path.read_text())
+    stale["ts"] = time.time() - 60  # silent past the 20 s cutoff
+    path.write_text(json.dumps(stale))
+    table = render_table(read_statuses(tmp_path))
+    lines = table.splitlines()
+    assert "DEAD" not in lines[2]  # node 0 alive
+    assert "DEAD" in lines[3]  # node 1 evicted from the live view
+    page = render_html(read_statuses(tmp_path))
+    assert "class='dead'" in page and "class='alive'" in page
+
+
+def test_scenario_publishes_status(tmp_path):
+    from p2pfl_tpu.federation.scenario import Scenario
+
+    cfg = ScenarioConfig(
+        name="mon", n_nodes=4,
+        data=DataConfig(dataset="mnist", samples_per_node=150),
+        training=TrainingConfig(rounds=1, epochs_per_round=1,
+                                learning_rate=0.05),
+        log_dir=str(tmp_path),
+    )
+    sc = Scenario(cfg)
+    sc.run(rounds=1)
+    recs = read_statuses(tmp_path / "mon" / "status")
+    assert len(recs) == 4
+    assert all(r["round"] == 1 for r in recs)
+    assert {r["role"] for r in recs} == {"aggregator"}
+    assert all(isinstance(r["loss"], float) for r in recs)
+
+
+def test_monitor_cli_once(tmp_path, capsys):
+    from p2pfl_tpu.monitor import main
+
+    publish_status(tmp_path, 0, {"role": "server", "round": 2,
+                                 "accuracy": 0.75})
+    html_out = tmp_path / "dash.html"
+    assert main([str(tmp_path), "--once", "--html", str(html_out)]) == 0
+    out = capsys.readouterr().out
+    assert "NODE" in out and "server" in out
+    assert html_out.exists() and "0.7500" in html_out.read_text()

@@ -134,6 +134,12 @@ class ScenarioConfig:
     checkpoint_dir: str | None = None
     checkpoint_every: int = 0  # rounds; 0 = off
     log_dir: str | None = None
+    # TensorBoard event files alongside JSONL/CSV (tracking_args
+    # analog; needs log_dir)
+    tensorboard: bool = False
+    # jax.profiler trace of one steady-state round lands here
+    # (SURVEY §5.1: the reference has no profiler at all)
+    profile_dir: str | None = None
 
     def __post_init__(self):
         if self.federation not in FEDERATIONS:

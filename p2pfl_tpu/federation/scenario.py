@@ -95,7 +95,8 @@ class Scenario(Observable):
         )
         self.roles = [nc.role for nc in config.nodes]
         self.membership = Membership(n, config.protocol)
-        self.logger = MetricsLogger(config.log_dir, config.name)
+        self.logger = MetricsLogger(config.log_dir, config.name,
+                                    tensorboard=config.tensorboard)
         self.transport = MeshTransport(n)
         self.leader = next(
             (i for i, nc in enumerate(config.nodes)
@@ -332,58 +333,78 @@ class Scenario(Observable):
         ev = None
         ev_round = -1  # round the last evaluation reflects
         start_round = int(np.asarray(self.fed.round))
-        for r in range(start_round, start_round + rounds):
-            t0 = time.monotonic()
-            self.notify(Events.ROUND_STARTED, {"round": r})
-            alive = self._advance_membership(r)
-            self._rotate_leader(alive)
-            self.fed = self.fed.replace(
-                alive=self.transport.put_stacked(jnp.asarray(alive))
-            )
-            self.fed, metrics = self._round_fn(
-                self.fed, *self._data_args,
-                *self._plan_args(self._voted_trains(alive, r)),
-            )
-            jax.block_until_ready(self.fed.states.params)
-            self.notify(Events.AGGREGATION_FINISHED, {"round": r})
-            dt = time.monotonic() - t0
-            round_times.append(dt)
-            self.global_step += self._steps_per_round
-
-            train_loss = np.asarray(metrics["train_loss"], np.float64)
-            for i in range(cfg.n_nodes):
-                self.logger.log_metrics(
-                    {"Train/loss": float(train_loss[i]),
-                     "Train/round_time_s": dt},
-                    step=self.global_step, round=r, node=i,
+        # profile ONE steady-state round (the second of the run when
+        # there is one — the first carries compile time); SURVEY §5.1's
+        # jax.profiler hook. try/finally: an exception mid-profiled-
+        # round must not leave the tracer running.
+        profile_round = None
+        if cfg.profile_dir:
+            profile_round = start_round + (1 if rounds > 1 else 0)
+        tracing = False
+        try:
+            for r in range(start_round, start_round + rounds):
+                t0 = time.monotonic()
+                if r == profile_round:
+                    jax.profiler.start_trace(cfg.profile_dir)
+                    tracing = True
+                self.notify(Events.ROUND_STARTED, {"round": r})
+                alive = self._advance_membership(r)
+                self._rotate_leader(alive)
+                self.fed = self.fed.replace(
+                    alive=self.transport.put_stacked(jnp.asarray(alive))
                 )
-            self._publish_statuses(r, alive, train_loss, ev)
-            if cfg.training.eval_every and (r + 1) % cfg.training.eval_every == 0:
-                ev = self.evaluate()
-                ev_round = r
-                for i, (a, l) in enumerate(
-                    zip(ev["per_node_accuracy"], ev["per_node_loss"])
-                ):
+                self.fed, metrics = self._round_fn(
+                    self.fed, *self._data_args,
+                    *self._plan_args(self._voted_trains(alive, r)),
+                )
+                jax.block_until_ready(self.fed.states.params)
+                if tracing:
+                    jax.profiler.stop_trace()
+                    tracing = False
+                self.notify(Events.AGGREGATION_FINISHED, {"round": r})
+                dt = time.monotonic() - t0
+                round_times.append(dt)
+                self.global_step += self._steps_per_round
+
+                train_loss = np.asarray(metrics["train_loss"], np.float64)
+                for i in range(cfg.n_nodes):
                     self.logger.log_metrics(
-                        {"Test/accuracy": a, "Test/loss": l},
+                        {"Train/loss": float(train_loss[i]),
+                         "Train/round_time_s": dt},
                         step=self.global_step, round=r, node=i,
                     )
-                self.logger.log_metrics(
-                    {"Test/mean_accuracy": ev["mean_accuracy"],
-                     "Test/min_accuracy": ev["min_accuracy"]},
-                    step=self.global_step, round=r,
-                )
-                if (target_accuracy is not None and rounds_to_target is None
-                        and ev["mean_accuracy"] >= target_accuracy):
-                    rounds_to_target = r + 1
-            self.logger.log_metrics(resource_snapshot(),
-                                    step=self.global_step, round=r)
-            self.logger.round_marker(r, self.global_step)
-            if cfg.checkpoint_every and (r + 1) % cfg.checkpoint_every == 0:
-                if cfg.checkpoint_dir:
-                    path = save_checkpoint(cfg.checkpoint_dir, self.fed)
-                    self.notify(Events.CHECKPOINT_SAVED, {"path": str(path)})
-            self.notify(Events.ROUND_FINISHED, {"round": r, "time_s": dt})
+                self._publish_statuses(r, alive, train_loss, ev)
+                if cfg.training.eval_every and (r + 1) % cfg.training.eval_every == 0:
+                    ev = self.evaluate()
+                    ev_round = r
+                    for i, (a, l) in enumerate(
+                        zip(ev["per_node_accuracy"], ev["per_node_loss"])
+                    ):
+                        self.logger.log_metrics(
+                            {"Test/accuracy": a, "Test/loss": l},
+                            step=self.global_step, round=r, node=i,
+                        )
+                    self.logger.log_metrics(
+                        {"Test/mean_accuracy": ev["mean_accuracy"],
+                         "Test/min_accuracy": ev["min_accuracy"]},
+                        step=self.global_step, round=r,
+                    )
+                    if (target_accuracy is not None
+                            and rounds_to_target is None
+                            and ev["mean_accuracy"] >= target_accuracy):
+                        rounds_to_target = r + 1
+                self.logger.log_metrics(resource_snapshot(),
+                                        step=self.global_step, round=r)
+                self.logger.round_marker(r, self.global_step)
+                if cfg.checkpoint_every and (r + 1) % cfg.checkpoint_every == 0:
+                    if cfg.checkpoint_dir:
+                        path = save_checkpoint(cfg.checkpoint_dir, self.fed)
+                        self.notify(Events.CHECKPOINT_SAVED,
+                                    {"path": str(path)})
+                self.notify(Events.ROUND_FINISHED, {"round": r, "time_s": dt})
+        finally:
+            if tracing:  # exception mid-profiled-round
+                jax.profiler.stop_trace()
 
         last_round = start_round + rounds - 1
         if ev is None or ev_round != last_round:  # don't report stale eval

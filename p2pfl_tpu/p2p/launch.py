@@ -47,9 +47,18 @@ def _free_ports(n: int) -> list[int]:
 
 
 async def _run_node(cfg: ScenarioConfig, idx: int, ports: list[int],
-                    tls_dir: str | None = None) -> dict:
-    """One node's full lifecycle (node_start.py main analog)."""
+                    tls_dir: str | None = None,
+                    hosts: list[str] | None = None,
+                    bind: str = "127.0.0.1") -> dict:
+    """One node's full lifecycle (node_start.py main analog).
+
+    ``hosts`` gives each node's reachable address (container service
+    names in a compose deployment; defaults to loopback for localhost
+    federations); ``bind`` is this node's listen address ("0.0.0.0"
+    inside containers so peers can reach it).
+    """
     n = cfg.n_nodes
+    hosts = hosts or ["127.0.0.1"] * n
     tls = None
     if tls_dir:
         from p2pfl_tpu.p2p.tls import load_node_credentials
@@ -70,6 +79,7 @@ async def _run_node(cfg: ScenarioConfig, idx: int, ports: list[int],
     node = P2PNode(
         idx,
         learner,
+        host=bind,
         port=ports[idx],
         role=cfg.nodes[idx].role,
         n_nodes=n,
@@ -90,7 +100,7 @@ async def _run_node(cfg: ScenarioConfig, idx: int, ports: list[int],
         deadline = time.monotonic() + 60
         while True:
             try:
-                await node.connect_to("127.0.0.1", ports[j])
+                await node.connect_to(hosts[j], ports[j])
                 break
             except OSError:
                 if time.monotonic() > deadline:
@@ -138,9 +148,20 @@ async def _run_node(cfg: ScenarioConfig, idx: int, ports: list[int],
 
 
 def node_main(config_path: str, idx: int, ports: list[int],
-              tls_dir: str | None = None) -> None:
+              tls_dir: str | None = None,
+              hosts: list[str] | None = None,
+              bind: str = "127.0.0.1") -> None:
     cfg = ScenarioConfig.load(config_path)
-    result = asyncio.run(_run_node(cfg, idx, ports, tls_dir=tls_dir))
+    if cfg.log_dir:
+        # per-participant log trail + environment banner
+        # (base_node.py:133-158, utils/env.py parity)
+        from p2pfl_tpu.utils.env import log_environment
+        from p2pfl_tpu.utils.nodelog import setup_node_logging
+
+        setup_node_logging(cfg.log_dir, cfg.name, idx)
+        log_environment()
+    result = asyncio.run(_run_node(cfg, idx, ports, tls_dir=tls_dir,
+                                   hosts=hosts, bind=bind))
     print("P2PFL_RESULT " + json.dumps(result), flush=True)
 
 
@@ -198,6 +219,11 @@ def main(argv: list[str] | None = None) -> int:
                     help="force a JAX platform (e.g. cpu) in children")
     ap.add_argument("--tls-dir", default=None,
                     help="directory with scenario TLS material (child mode)")
+    ap.add_argument("--hosts", default=None,
+                    help="comma-separated per-node hostnames (child mode; "
+                         "compose service names in a container deployment)")
+    ap.add_argument("--bind", default="127.0.0.1",
+                    help="listen address (0.0.0.0 inside containers)")
     args = ap.parse_args(argv)
     if args.platform:
         import jax
@@ -206,7 +232,9 @@ def main(argv: list[str] | None = None) -> int:
     if args.node is not None:
         node_main(args.config, args.node,
                   [int(p) for p in args.ports.split(",")],
-                  tls_dir=args.tls_dir)
+                  tls_dir=args.tls_dir,
+                  hosts=args.hosts.split(",") if args.hosts else None,
+                  bind=args.bind)
         return 0
     cfg = ScenarioConfig.load(args.config)
     results = launch(cfg, args.config, platform=args.platform)

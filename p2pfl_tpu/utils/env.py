@@ -1,0 +1,61 @@
+"""Environment probe.
+
+Parity with the reference's utils/env.py (logs OS / python / torch /
+psutil / GPU info at node start), re-pointed at the TPU stack: OS,
+python, jax/jaxlib versions, backend platform, device inventory, and
+host memory/CPU.
+"""
+
+from __future__ import annotations
+
+import logging
+import platform
+import sys
+from typing import Any
+
+log = logging.getLogger("p2pfl_tpu.env")
+
+
+def environment_report(include_devices: bool = True) -> dict[str, Any]:
+    """Collect the environment facts as one dict (JSON-safe)."""
+    report: dict[str, Any] = {
+        "os": platform.platform(),
+        "machine": platform.machine(),
+        "python": sys.version.split()[0],
+    }
+    try:
+        import numpy as np
+
+        report["numpy"] = np.__version__
+    except Exception:  # pragma: no cover
+        pass
+    try:
+        import jax
+
+        report["jax"] = jax.__version__
+        if include_devices:
+            devices = jax.devices()
+            report["backend"] = devices[0].platform
+            report["device_kind"] = devices[0].device_kind
+            report["n_devices"] = len(devices)
+            report["process_index"] = jax.process_index()
+            report["process_count"] = jax.process_count()
+    except Exception as e:  # pragma: no cover - backend init failures
+        report["jax_error"] = str(e)
+    try:
+        import psutil
+
+        vm = psutil.virtual_memory()
+        report["cpu_count"] = psutil.cpu_count()
+        report["ram_gb"] = round(vm.total / 2**30, 2)
+    except Exception:  # pragma: no cover
+        pass
+    return report
+
+
+def log_environment() -> dict[str, Any]:
+    """Log the report at INFO (the reference's node-start banner)."""
+    report = environment_report()
+    for key, value in report.items():
+        log.info("env %s = %s", key, value)
+    return report

@@ -6,9 +6,11 @@ trainer steps via an accumulated global step
 (statisticslogger.py:131-153, lightninglearner.py:162-165), the CSV
 option (node.py:122-125), and round markers (node.py:642).
 
-Backends here: JSONL (machine-readable event stream) + per-node CSV.
-TensorBoard is omitted deliberately — the JSONL stream carries the
-same (step, round, metric) triples and has no service dependency.
+Backends here: JSONL (machine-readable event stream) + per-node CSV,
+plus an optional TensorBoard backend (``tensorboard=True``) writing one
+event-file run per node and one for the federation — drop-in for the
+reference users' `tensorboard --logdir` workflow, with the same
+FL-aware global-step x-axis.
 """
 
 from __future__ import annotations
@@ -28,11 +30,18 @@ class MetricsLogger:
     federation-level metric (e.g. mean accuracy).
     """
 
-    def __init__(self, log_dir: str | pathlib.Path | None, name: str = "scenario"):
+    def __init__(self, log_dir: str | pathlib.Path | None, name: str = "scenario",
+                 tensorboard: bool = False):
         self.enabled = log_dir is not None
         self.name = name
         self._csv_files: dict[int, Any] = {}
         self._csv_writers: dict[int, Any] = {}
+        self._tb_writers: dict[Any, Any] = {}
+        self._tensorboard = tensorboard and self.enabled
+        if self._tensorboard:
+            # fail FAST at construction, not mid-run after training
+            # compute was spent
+            from torch.utils.tensorboard import SummaryWriter  # noqa: F401
         self.history: list[dict] = []  # in-memory view for tests/benchmarks
         if self.enabled:
             self.dir = pathlib.Path(log_dir) / name
@@ -57,6 +66,23 @@ class MetricsLogger:
         self._jsonl.write(json.dumps(rec) + "\n")
         if node is not None:
             self._node_csv(node, rec)
+        if self._tensorboard:
+            self._tb(node, metrics, step)
+
+    def _tb(self, node: int | None, metrics: dict, step: int) -> None:
+        """TensorBoard backend (statisticslogger.py:131-153 parity: the
+        x-axis is the FL-aware accumulated global step, so per-round
+        trainer curves concatenate into one line per node)."""
+        key = "federation" if node is None else f"node_{node}"
+        if key not in self._tb_writers:
+            from torch.utils.tensorboard import SummaryWriter
+
+            self._tb_writers[key] = SummaryWriter(
+                str(self.dir / "tb" / key)
+            )
+        w = self._tb_writers[key]
+        for name, value in metrics.items():
+            w.add_scalar(name, float(value), int(step))
 
     def _node_csv(self, node: int, rec: dict) -> None:
         # long format (ts, step, round, metric, value): metric sets vary
@@ -85,3 +111,5 @@ class MetricsLogger:
             self._jsonl.close()
         for f in self._csv_files.values():
             f.close()
+        for w in self._tb_writers.values():
+            w.close()

@@ -1,0 +1,63 @@
+"""Per-participant file logging.
+
+Parity with the reference's 4-handler setup (base_node.py:133-158):
+each node process writes ``node_<idx>.log`` (INFO+),
+``node_<idx>_debug.log`` (DEBUG records only), and
+``node_<idx>_error.log`` (ERROR+), alongside the console handler —
+so a multi-process scenario leaves one inspectable log trail per
+participant under ``<log_dir>/<scenario>/logs/``.
+"""
+
+from __future__ import annotations
+
+import logging
+import pathlib
+
+_FMT = "%(asctime)s %(levelname)s %(name)s: %(message)s"
+
+
+class _DebugOnly(logging.Filter):
+    """The reference's debug file holds ONLY debug records
+    (base_node.py:151)."""
+
+    def filter(self, record: logging.LogRecord) -> bool:
+        return record.levelno == logging.DEBUG
+
+
+def setup_node_logging(log_dir: str | pathlib.Path, name: str,
+                       idx: int, console: bool = True) -> pathlib.Path:
+    """Install the per-node handlers on the root logger; returns the
+    log directory. Idempotent per (dir, idx): repeated calls don't
+    stack duplicate handlers."""
+    directory = pathlib.Path(log_dir) / name / "logs"
+    directory.mkdir(parents=True, exist_ok=True)
+    root = logging.getLogger()
+    root.setLevel(logging.DEBUG)
+    marker = f"p2pfl-node-{directory}-{idx}"
+    if any(getattr(h, "_p2pfl_marker", None) == marker for h in root.handlers):
+        return directory
+    fmt = logging.Formatter(_FMT)
+    specs = [
+        (directory / f"node_{idx}.log", logging.INFO, None),
+        (directory / f"node_{idx}_debug.log", logging.DEBUG, _DebugOnly()),
+        (directory / f"node_{idx}_error.log", logging.ERROR, None),
+    ]
+    for path, level, filt in specs:
+        h = logging.FileHandler(path)
+        h.setLevel(level)
+        h.setFormatter(fmt)
+        if filt is not None:
+            h.addFilter(filt)
+        h._p2pfl_marker = marker
+        root.addHandler(h)
+    if console and not any(
+        isinstance(h, logging.StreamHandler)
+        and not isinstance(h, logging.FileHandler)
+        for h in root.handlers
+    ):
+        sh = logging.StreamHandler()
+        sh.setLevel(logging.INFO)
+        sh.setFormatter(fmt)
+        sh._p2pfl_marker = marker
+        root.addHandler(sh)
+    return directory

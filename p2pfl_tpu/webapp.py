@@ -1,0 +1,252 @@
+"""Scenario web dashboard — the L5 frontend, stdlib-only.
+
+The reference's largest subsystem is a Flask app + SQLite
+(webserver/app.py:260-714, database.py): scenario list, live node
+monitoring, log viewers, REST intake. This module delivers that
+*capability* with no service dependencies: a `http.server` app that
+reads the same on-disk artifacts the framework already writes
+(`status/` records, `metrics.jsonl`, `logs/*.log`) and serves
+
+- ``/``                    — scenario list (every run under the log root)
+- ``/scenario/<name>``     — live node table (auto-refreshing) + links
+- ``/api/scenarios``       — JSON scenario index
+- ``/api/scenario/<name>`` — JSON node statuses (the monitoring feed)
+- ``/api/metrics/<name>``  — JSON tail of the metrics stream
+- ``/logs/<name>/<file>``  — tail of a node's log file, rendered
+
+The filesystem IS the database: node upserts are the atomic
+``node_*.status.json`` replaces (webserver/database.py:253-274's
+role), so the dashboard needs no writer process and works for
+in-process scenarios, socket federations, and compose deployments
+sharing a log volume.
+
+Run: ``python -m p2pfl_tpu.webapp <log_root> [--port 8666]``
+"""
+
+from __future__ import annotations
+
+import argparse
+import html
+import json
+import pathlib
+import sys
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import unquote
+
+from p2pfl_tpu.utils.monitor import (
+    DEFAULT_LIVENESS_S,
+    read_statuses,
+    render_html,
+)
+
+_STYLE = """
+body{font-family:monospace;background:#111;color:#ddd;padding:1em}
+a{color:#7cf} table{border-collapse:collapse}
+td,th{padding:.3em .8em;border:1px solid #333} th{background:#222}
+pre{background:#000;padding:1em;overflow-x:auto}
+"""
+
+
+def _page(title: str, body: str, refresh: int | None = None) -> bytes:
+    meta = (
+        f'<meta http-equiv="refresh" content="{refresh}">' if refresh else ""
+    )
+    return (
+        f"<!doctype html><html><head>{meta}<title>{title}</title>"
+        f"<style>{_STYLE}</style></head><body><h2>{title}</h2>{body}"
+        "</body></html>"
+    ).encode()
+
+
+def list_scenarios(root: pathlib.Path) -> list[dict]:
+    """Scenario index (the SQLite ``scenarios`` table's role,
+    database.py:317-357): every log-root subdir that looks like a run."""
+    out = []
+    if not root.is_dir():
+        return out
+    for d in sorted(root.iterdir()):
+        if not d.is_dir():
+            continue
+        statuses = read_statuses(d / "status")
+        newest = max((s.get("ts", 0.0) for s in statuses), default=0.0)
+        age = time.time() - newest if newest else None
+        out.append(
+            {
+                "name": d.name,
+                "n_nodes": len(statuses),
+                "running": age is not None and age <= DEFAULT_LIVENESS_S,
+                "has_metrics": (d / "metrics.jsonl").exists(),
+                "last_seen_s": round(age, 1) if age is not None else None,
+            }
+        )
+    return out
+
+
+def _tail_text(path: pathlib.Path, max_bytes: int = 65536) -> str:
+    """Last ``max_bytes`` of a file without reading the whole thing —
+    dashboards auto-refresh every few seconds against logs that grow
+    unboundedly, so tails must be O(window), not O(file)."""
+    with open(path, "rb") as f:
+        f.seek(0, 2)
+        size = f.tell()
+        f.seek(max(0, size - max_bytes))
+        data = f.read()
+    text = data.decode("utf-8", errors="replace")
+    # drop the first (likely partial) line when the window is clipped
+    if size > max_bytes and "\n" in text:
+        text = text.split("\n", 1)[1]
+    return text
+
+
+def tail_metrics(root: pathlib.Path, name: str, n: int = 200) -> list[dict]:
+    path = root / name / "metrics.jsonl"
+    if not path.exists():
+        return []
+    lines = _tail_text(path, max_bytes=256 * 1024).splitlines()[-n:]
+    out = []
+    for line in lines:
+        try:
+            out.append(json.loads(line))
+        except ValueError:
+            continue
+    return out
+
+
+class DashboardHandler(BaseHTTPRequestHandler):
+    root: pathlib.Path  # set by make_server
+
+    def log_message(self, *args) -> None:  # quiet
+        pass
+
+    def _send(self, body: bytes, ctype: str = "text/html",
+              code: int = 200) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _json(self, obj) -> None:
+        self._send(json.dumps(obj).encode(), "application/json")
+
+    def _safe_child(self, *parts: str) -> pathlib.Path | None:
+        """Resolve a path strictly under the log root: every segment
+        must be a single clean path component (no separators — URL
+        %2F-decoding happens before this — and no dot-dots), and the
+        resolved path must still live under the root (symlink guard)."""
+        for part in parts:
+            if (not part or part in (".", "..")
+                    or "/" in part or "\\" in part or "\x00" in part):
+                return None
+        p = self.root.joinpath(*parts).resolve()
+        return p if p.is_relative_to(self.root.resolve()) else None
+
+    def do_GET(self) -> None:  # noqa: N802 (http.server API)
+        parts = [unquote(p) for p in self.path.split("?")[0].split("/") if p]
+        try:
+            self._route(parts)
+        except BrokenPipeError:
+            pass
+        except Exception as e:  # any handler bug -> 500, keep serving
+            self._send(_page("error", f"<pre>{html.escape(str(e))}</pre>"),
+                       code=500)
+
+    def _route(self, parts: list[str]) -> None:
+        if not parts:
+            return self._index()
+        if parts[0] == "api":
+            if len(parts) == 2 and parts[1] == "scenarios":
+                return self._json(list_scenarios(self.root))
+            if len(parts) == 3 and parts[1] == "scenario":
+                safe = self._safe_child(parts[2], "status")
+                if safe is None:
+                    return self._json([])
+                return self._json(read_statuses(safe))
+            if len(parts) == 3 and parts[1] == "metrics":
+                if self._safe_child(parts[2]) is None:
+                    return self._json([])
+                return self._json(tail_metrics(self.root, parts[2]))
+        if len(parts) == 2 and parts[0] == "scenario":
+            return self._scenario(parts[1])
+        if len(parts) == 3 and parts[0] == "logs":
+            return self._logfile(parts[1], parts[2])
+        self._send(_page("not found", "<p>404</p>"), code=404)
+
+    def _index(self) -> None:
+        rows = "".join(
+            "<tr><td><a href='/scenario/{n}'>{n}</a></td><td>{c}</td>"
+            "<td>{r}</td><td>{m}</td></tr>".format(
+                n=html.escape(s["name"]), c=s["n_nodes"],
+                r="running" if s["running"] else "stopped",
+                m="yes" if s["has_metrics"] else "-",
+            )
+            for s in list_scenarios(self.root)
+        )
+        body = (
+            "<table><tr><th>SCENARIO</th><th>NODES</th><th>STATE</th>"
+            f"<th>METRICS</th></tr>{rows}</table>"
+        )
+        self._send(_page("p2pfl_tpu scenarios", body, refresh=5))
+
+    def _scenario(self, name: str) -> None:
+        safe = self._safe_child(name)
+        if safe is None or not safe.is_dir():
+            return self._send(_page("not found", "<p>404</p>"), code=404)
+        statuses = read_statuses(safe / "status")
+        table = render_html(statuses)
+        # reuse only the table body of render_html inside our page
+        inner = table.split("<body>")[1].split("</body>")[0]
+        logs = sorted((safe / "logs").glob("*.log")) if (
+            safe / "logs").is_dir() else []
+        links = " | ".join(
+            f"<a href='/logs/{html.escape(name)}/{p.name}'>{p.name}</a>"
+            for p in logs
+        )
+        body = (
+            inner
+            + f"<p><a href='/api/metrics/{html.escape(name)}'>metrics</a>"
+            + (f" | logs: {links}" if links else "")
+            + "</p>"
+        )
+        self._send(_page(f"scenario {html.escape(name)}", body, refresh=2))
+
+    def _logfile(self, name: str, fname: str) -> None:
+        path = self._safe_child(name, "logs", fname)
+        if path is None or not path.is_file():
+            return self._send(_page("not found", "<p>404</p>"), code=404)
+        # bounded tail with escaping (the reference's ANSI->HTML log
+        # viewer, webserver/app.py:443-500; our logs carry no ANSI codes)
+        tail = "\n".join(_tail_text(path).splitlines()[-500:])
+        self._send(
+            _page(f"{html.escape(fname)}",
+                  f"<pre>{html.escape(tail)}</pre>", refresh=5)
+        )
+
+
+def make_server(log_root: str | pathlib.Path, port: int = 8666,
+                host: str = "127.0.0.1") -> ThreadingHTTPServer:
+    handler = type(
+        "BoundHandler", (DashboardHandler,),
+        {"root": pathlib.Path(log_root)},
+    )
+    return ThreadingHTTPServer((host, port), handler)
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(prog="p2pfl_tpu.webapp")
+    ap.add_argument("log_root", help="the scenarios' log_dir root")
+    ap.add_argument("--port", type=int, default=8666)
+    ap.add_argument("--host", default="127.0.0.1")
+    args = ap.parse_args(argv)
+    server = make_server(args.log_root, args.port, args.host)
+    print(f"dashboard on http://{args.host}:{server.server_address[1]}/")
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,109 @@
+"""Observability + deployment surface: TensorBoard backend, per-node
+log files, env probe, jax.profiler hook, docker-compose generation
+(reference parity: statisticslogger.py, base_node.py:133-158,
+utils/env.py, controller.py:347-454)."""
+
+import logging
+import pathlib
+
+import numpy as np
+import pytest
+
+from p2pfl_tpu.config.schema import DataConfig, ScenarioConfig, TrainingConfig
+from p2pfl_tpu.utils.env import environment_report
+from p2pfl_tpu.utils.metrics import MetricsLogger
+from p2pfl_tpu.utils.nodelog import setup_node_logging
+
+
+def test_tensorboard_backend_writes_event_files(tmp_path):
+    ml = MetricsLogger(tmp_path, "tb-test", tensorboard=True)
+    ml.log_metrics({"Train/loss": 1.5}, step=10, round=0, node=0)
+    ml.log_metrics({"Train/loss": 1.2}, step=20, round=1, node=0)
+    ml.log_metrics({"Test/mean_accuracy": 0.7}, step=20, round=1)
+    ml.close()
+    tb = tmp_path / "tb-test" / "tb"
+    assert list((tb / "node_0").glob("events.out.tfevents.*"))
+    assert list((tb / "federation").glob("events.out.tfevents.*"))
+    # JSONL backend still written alongside
+    assert (tmp_path / "tb-test" / "metrics.jsonl").exists()
+
+
+def test_per_node_log_files(tmp_path):
+    logdir = setup_node_logging(tmp_path, "s", 3, console=False)
+    log = logging.getLogger("p2pfl_tpu.test")
+    log.info("hello info")
+    log.debug("hello debug")
+    log.error("hello error")
+    # idempotent: no duplicate handlers on re-setup
+    setup_node_logging(tmp_path, "s", 3, console=False)
+    log.info("second info")
+    main = (logdir / "node_3.log").read_text()
+    debug = (logdir / "node_3_debug.log").read_text()
+    err = (logdir / "node_3_error.log").read_text()
+    assert "hello info" in main and "hello error" in main
+    assert "hello debug" not in main
+    assert "hello debug" in debug and "hello info" not in debug
+    assert "hello error" in err and "hello info" not in err
+    assert main.count("second info") == 1
+
+
+def test_environment_report():
+    rep = environment_report()
+    assert rep["python"] and rep["os"]
+    assert rep["jax"]
+    assert rep["n_devices"] >= 1
+    assert rep["backend"] in ("cpu", "tpu", "gpu")
+
+
+def test_profiler_hook_writes_trace(tmp_path):
+    from p2pfl_tpu.federation.scenario import Scenario
+
+    cfg = ScenarioConfig(
+        name="prof", n_nodes=4,
+        data=DataConfig(dataset="mnist", samples_per_node=100),
+        training=TrainingConfig(rounds=2, epochs_per_round=1,
+                                learning_rate=0.05),
+        profile_dir=str(tmp_path / "trace"),
+    )
+    Scenario(cfg).run()
+    # jax.profiler writes plugins/profile/<ts>/*.trace.json.gz et al
+    assert list(pathlib.Path(tmp_path / "trace").rglob("*")), (
+        "profiler produced no trace files"
+    )
+
+
+def test_compose_generation_and_cleanup(tmp_path):
+    from p2pfl_tpu.deploy import cleanup, generate_compose
+
+    cfg = ScenarioConfig(
+        name="dep", n_nodes=3, encrypt=True,
+        data=DataConfig(dataset="mnist", samples_per_node=100),
+    )
+    compose = generate_compose(cfg, tmp_path)
+    text = compose.read_text()
+    assert (tmp_path / "Dockerfile").exists()
+    assert (tmp_path / "scenario.json").exists()
+    assert (tmp_path / "tls" / "node2.crt").exists()  # encrypt material
+    for i in range(3):
+        assert f"dep-node{i}:" in text
+        assert f"--node\", \"{i}\"" in text
+    assert "--tls-dir" in text
+    assert text.count("build: .") == 3
+    # cleanup renders container + port kills without executing
+    cmds = cleanup(cfg, dry_run=True)
+    assert any("docker rm -f dep-node0" in c for c in cmds)
+    assert any("fuser -k" in c for c in cmds)
+
+
+def test_compose_cli(tmp_path, capsys):
+    from p2pfl_tpu.deploy import main
+
+    cfg = ScenarioConfig(name="cli-dep", n_nodes=2,
+                         data=DataConfig(dataset="mnist",
+                                         samples_per_node=100))
+    path = tmp_path / "s.json"
+    cfg.save(path)
+    assert main([str(path), "--out", str(tmp_path / "out")]) == 0
+    out = capsys.readouterr().out
+    assert "docker compose" in out
+    assert (tmp_path / "out" / "docker-compose.yml").exists()

@@ -1,0 +1,104 @@
+"""L5 dashboard server (p2pfl_tpu.webapp): scenario index, live node
+feed, metrics tail, log viewer, traversal safety — the reference's
+Flask monitoring surface (webserver/app.py:260-714) minus the service
+dependencies."""
+
+import json
+import threading
+import urllib.request
+
+import pytest
+
+from p2pfl_tpu.utils.metrics import MetricsLogger
+from p2pfl_tpu.utils.monitor import publish_status
+from p2pfl_tpu.utils.nodelog import setup_node_logging
+from p2pfl_tpu.webapp import list_scenarios, make_server
+
+
+@pytest.fixture()
+def server(tmp_path):
+    # one "running" scenario with statuses, metrics, and a log file
+    publish_status(tmp_path / "alpha" / "status", 0,
+                   {"role": "aggregator", "round": 2, "loss": 0.5})
+    publish_status(tmp_path / "alpha" / "status", 1,
+                   {"role": "trainer", "round": 2, "accuracy": 0.9})
+    ml = MetricsLogger(tmp_path, "alpha")
+    ml.log_metrics({"Train/loss": 0.5}, step=5, round=2, node=0)
+    ml.close()
+    logdir = setup_node_logging(tmp_path, "alpha", 0, console=False)
+    import logging
+
+    logging.getLogger("p2pfl_tpu.t").info("webapp log line")
+    for h in list(logging.getLogger().handlers):  # flush + detach
+        if getattr(h, "_p2pfl_marker", "").startswith(
+            f"p2pfl-node-{logdir}"
+        ):
+            h.close()
+            logging.getLogger().removeHandler(h)
+    srv = make_server(tmp_path, port=0)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield f"http://127.0.0.1:{srv.server_address[1]}"
+    srv.shutdown()
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return r.status, r.read().decode()
+
+
+def test_scenario_index_and_api(server, tmp_path):
+    scenarios = list_scenarios(tmp_path)
+    assert [s["name"] for s in scenarios] == ["alpha"]
+    assert scenarios[0]["running"] and scenarios[0]["n_nodes"] == 2
+
+    status, body = _get(server + "/")
+    assert status == 200 and "alpha" in body and "running" in body
+
+    status, body = _get(server + "/api/scenarios")
+    assert json.loads(body)[0]["has_metrics"]
+
+    status, body = _get(server + "/api/scenario/alpha")
+    recs = json.loads(body)
+    assert [r["node"] for r in recs] == [0, 1]
+    assert recs[1]["accuracy"] == 0.9
+
+
+def test_live_node_page_and_metrics(server):
+    status, body = _get(server + "/scenario/alpha")
+    assert status == 200
+    assert "aggregator" in body and "0.9000" in body
+    assert "node_0.log" in body  # log link rendered
+
+    status, body = _get(server + "/api/metrics/alpha")
+    recs = json.loads(body)
+    assert recs and recs[-1]["Train/loss"] == 0.5
+
+
+def test_log_viewer_and_404s(server):
+    status, body = _get(server + "/logs/alpha/node_0.log")
+    assert status == 200 and "webapp log line" in body
+
+    for path in ("/scenario/nope", "/logs/alpha/none.log", "/bogus"):
+        try:
+            status, _ = _get(server + path)
+        except urllib.error.HTTPError as e:
+            status = e.code
+        assert status == 404, path
+
+
+def test_traversal_refused(server):
+    import urllib.error
+
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _get(server + "/logs/alpha/..%2F..%2Fetc%2Fpasswd")
+    assert e.value.code == 404
+    # %2F re-introduces separators AFTER path splitting — the API
+    # routes must reject those segments too (empty result, no read)
+    for path in ("/api/metrics/..%2F..%2Foutside",
+                 "/api/scenario/..%2F.."):
+        status, body = _get(server + path)
+        assert status == 200 and json.loads(body) == []
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _get(server + "/scenario/..%2F..")
+    assert e.value.code == 404

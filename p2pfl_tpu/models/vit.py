@@ -23,25 +23,31 @@ class TransformerBlock(nn.Module):
     dtype: jnp.dtype = jnp.bfloat16
     param_dtype: jnp.dtype = jnp.float32
     seq_axis: str | None = None  # mesh axis for ring attention
+    use_flash: bool = False  # Pallas fused local attention (ops.flash)
+
+    def _qkv(self, y):
+        head = (self.heads, self.dim // self.heads)
+        return tuple(
+            nn.DenseGeneral(head, dtype=self.dtype,
+                            param_dtype=self.param_dtype, name=name)(y)
+            for name in ("query", "key", "value")
+        )
 
     @nn.compact
     def __call__(self, x):
         y = nn.LayerNorm(dtype=self.dtype, param_dtype=self.param_dtype)(x)
-        if self.seq_axis is not None:
-            from p2pfl_tpu.ops.ring_attention import ring_self_attention
+        if self.seq_axis is not None or self.use_flash:
+            if self.seq_axis is not None:
+                from p2pfl_tpu.ops.ring_attention import ring_self_attention
 
-            y = ring_self_attention(
-                nn.DenseGeneral((self.heads, self.dim // self.heads),
-                                dtype=self.dtype, param_dtype=self.param_dtype,
-                                name="query")(y),
-                nn.DenseGeneral((self.heads, self.dim // self.heads),
-                                dtype=self.dtype, param_dtype=self.param_dtype,
-                                name="key")(y),
-                nn.DenseGeneral((self.heads, self.dim // self.heads),
-                                dtype=self.dtype, param_dtype=self.param_dtype,
-                                name="value")(y),
-                axis_name=self.seq_axis,
-            )
+                attn = lambda q, k, v: ring_self_attention(
+                    q, k, v, axis_name=self.seq_axis
+                )
+            else:
+                from p2pfl_tpu.ops.flash import flash_attention
+
+                attn = flash_attention
+            y = attn(*self._qkv(y))
             y = nn.DenseGeneral(self.dim, axis=(-2, -1), dtype=self.dtype,
                                 param_dtype=self.param_dtype, name="out")(y)
         else:
@@ -68,6 +74,7 @@ class ViT(nn.Module):
     dtype: jnp.dtype = jnp.bfloat16
     param_dtype: jnp.dtype = jnp.float32
     seq_axis: str | None = None
+    use_flash: bool = False
 
     @nn.compact
     def __call__(self, x):
@@ -85,7 +92,8 @@ class ViT(nn.Module):
         for _ in range(self.depth):
             x = TransformerBlock(self.dim, self.heads, dtype=self.dtype,
                                  param_dtype=self.param_dtype,
-                                 seq_axis=self.seq_axis)(x)
+                                 seq_axis=self.seq_axis,
+                                 use_flash=self.use_flash)(x)
         x = nn.LayerNorm(dtype=self.dtype, param_dtype=self.param_dtype)(x)
         x = jnp.mean(x, axis=1)
         x = nn.Dense(self.num_classes, dtype=self.dtype,

@@ -10,8 +10,17 @@ keeps its hot custom ops:
 - ``ulysses_attention``: all-to-all (DeepSpeed-Ulysses-style) sequence
   parallelism — heads sharded during attention, sequence sharded
   elsewhere.
+- ``flash_attention``: Pallas fused attention kernel for the on-device
+  block — O(block) memory, streaming K/V through VMEM with running
+  softmax stats; shape-guarded fallback to the XLA path.
 """
 
+from p2pfl_tpu.ops.flash import flash_attention, reference_attention
 from p2pfl_tpu.ops.ring_attention import ring_self_attention, ulysses_attention
 
-__all__ = ["ring_self_attention", "ulysses_attention"]
+__all__ = [
+    "flash_attention",
+    "reference_attention",
+    "ring_self_attention",
+    "ulysses_attention",
+]

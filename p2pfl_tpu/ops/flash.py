@@ -1,0 +1,148 @@
+"""Pallas flash attention — the TPU-kernel local-attention hot op.
+
+The sequence-parallel layer (p2pfl_tpu.ops.ring_attention) handles the
+CROSS-device axis with ppermute; this module handles the ON-device
+block: a fused attention kernel that never materializes the [sq, sk]
+score matrix in HBM. Per (batch x head, q-block) grid cell, the kernel
+streams K/V blocks through VMEM, keeps flash running-softmax stats
+(row max m, row sum l) in registers, and hits the MXU with the
+q @ k^T and p @ v contractions. Memory: O(block_q x d) per cell
+instead of O(sq x sk).
+
+``flash_attention`` is shape-guarded: inputs whose sequence lengths
+don't tile by the block sizes (or whose head_dim exceeds one VMEM
+lane tile) fall back to the mathematically identical XLA path, so
+callers can use it unconditionally. ``interpret=True`` runs the same
+kernel on CPU for CI parity tests (tests/test_flash.py).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def reference_attention(q, k, v):
+    """Plain softmax attention ([b, s, h, d] layout) — the fallback and
+    the parity oracle."""
+    d = q.shape[-1]
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) / (d**0.5)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)
+
+
+def _attn_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, scale: float):
+    """One (batch*head, q-block) grid cell: full pass over K/V blocks
+    with flash running-softmax accumulation."""
+    bq, d = q_ref.shape
+    sk = k_ref.shape[0]
+    q = q_ref[:].astype(jnp.float32) * scale
+
+    def body(i, carry):
+        m, l, acc = carry
+        import jax.experimental.pallas as pl
+
+        k = k_ref[pl.ds(i * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[pl.ds(i * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(  # [bq, bk] on the MXU
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_blk = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m, m_blk)
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc * corr + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return m_new, l_new, acc_new
+
+    m0 = jnp.full((bq, 1), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((bq, 1), jnp.float32)
+    a0 = jnp.zeros((bq, d), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, sk // block_k, body, (m0, l0, a0))
+    o_ref[:] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+def flash_attention(q, k, v, block_q: int = 128, block_k: int = 128,
+                    interpret: bool | None = None):
+    """Fused attention for [b, s, h, d] inputs; falls back to the XLA
+    path when shapes don't tile (the kernel demands sq % block_q ==
+    sk % block_k == 0 and head_dim <= 128).
+
+    ``interpret=None`` auto-selects: real Mosaic lowering on TPU, the
+    Pallas interpreter on CPU hosts (pallas has no compiled CPU path —
+    this keeps the one code path runnable on the CI mesh).
+
+    Differentiable: the forward pass is the fused kernel; the backward
+    pass recomputes through the XLA oracle (rematerialized scores on
+    backward only — the standard first rung before a fused backward
+    kernel)."""
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    block_q = _pick_block(sq, block_q)
+    block_k = _pick_block(sk, block_k)
+    if block_q is None or block_k is None or d > 128:
+        return reference_attention(q, k, v)
+    if interpret is None:
+        interpret = jax.default_backend() == "cpu"
+    return _flash(q, k, v, block_q, block_k, interpret)
+
+
+def _pick_block(s: int, block: int) -> int | None:
+    """A block size that tiles the sequence AND the hardware: clamped
+    to the sequence, dividing it exactly, sublane-aligned (8 for f32 —
+    a 100-row block would fail Mosaic lowering on a real chip even
+    though it divides a 100-long sequence). None = use the fallback."""
+    b = min(block, s)
+    if s % b == 0 and b % 8 == 0:
+        return b
+    return None
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash(q, k, v, block_q: int, block_k: int, interpret: bool):
+    return _flash_forward(q, k, v, block_q, block_k, interpret)
+
+
+def _flash_fwd(q, k, v, block_q, block_k, interpret):
+    return _flash_forward(q, k, v, block_q, block_k, interpret), (q, k, v)
+
+
+def _flash_bwd(block_q, block_k, interpret, residuals, g):
+    q, k, v = residuals
+    _, vjp = jax.vjp(reference_attention, q, k, v)
+    return vjp(g)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def _flash_forward(q, k, v, block_q: int, block_k: int, interpret: bool):
+    import jax.experimental.pallas as pl
+
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    scale = 1.0 / (d**0.5)
+    # [b, s, h, d] -> [b*h, s, d]: one grid row per (batch, head)
+    def fold(x, s):
+        return x.transpose(0, 2, 1, 3).reshape(b * h, s, x.shape[-1])
+
+    qr, kr, vr = fold(q, sq), fold(k, sk), fold(v, sk)
+    out = pl.pallas_call(
+        functools.partial(_attn_kernel, block_k=block_k, scale=scale),
+        grid=(b * h, sq // block_q),
+        in_specs=[
+            pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, sk, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((None, sk, d), lambda i, j: (i, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        interpret=interpret,
+    )(qr, kr, vr)
+    return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)

@@ -92,11 +92,11 @@ def render_table(statuses: list[dict[str, Any]], now: float | None = None,
     return "\n".join(lines)
 
 
-def render_html(statuses: list[dict[str, Any]], now: float | None = None,
-                liveness_s: float = DEFAULT_LIVENESS_S,
-                refresh_s: int = 2) -> str:
-    """Self-contained dashboard page (auto-refreshes via meta tag —
-    re-render it in a loop with --watch for a live view)."""
+def render_table_html(statuses: list[dict[str, Any]],
+                      now: float | None = None,
+                      liveness_s: float = DEFAULT_LIVENESS_S) -> str:
+    """Just the node ``<table>`` — shared by the standalone dashboard
+    page below and the webapp's scenario page."""
     now = time.time() if now is None else now
     rows = [_row(r, now, liveness_s) for r in statuses]
     body = "".join(
@@ -107,6 +107,16 @@ def render_html(statuses: list[dict[str, Any]], now: float | None = None,
         for r in rows
     )
     head = "".join(f"<th>{c.upper()}</th>" for c in _COLUMNS)
+    return f"<table><tr>{head}</tr>{body}</table>"
+
+
+def render_html(statuses: list[dict[str, Any]], now: float | None = None,
+                liveness_s: float = DEFAULT_LIVENESS_S,
+                refresh_s: int = 2) -> str:
+    """Self-contained dashboard page (auto-refreshes via meta tag —
+    re-render it in a loop with --watch for a live view)."""
+    now = time.time() if now is None else now
+    table = render_table_html(statuses, now, liveness_s)
     return f"""<!doctype html><html><head>
 <meta http-equiv="refresh" content="{refresh_s}">
 <title>p2pfl_tpu federation</title>
@@ -116,7 +126,7 @@ table{{border-collapse:collapse}} td,th{{padding:.3em .8em;border:1px solid #333
 tr.dead td{{color:#f55}} th{{background:#222}}
 </style></head><body>
 <h2>federation status — {time.strftime('%H:%M:%S', time.localtime(now))}</h2>
-<table><tr>{head}</tr>{body}</table>
+{table}
 </body></html>"""
 
 

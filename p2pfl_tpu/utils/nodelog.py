@@ -32,7 +32,11 @@ def setup_node_logging(log_dir: str | pathlib.Path, name: str,
     directory = pathlib.Path(log_dir) / name / "logs"
     directory.mkdir(parents=True, exist_ok=True)
     root = logging.getLogger()
-    root.setLevel(logging.DEBUG)
+    # DEBUG is scoped to the framework's own logger tree — raising the
+    # ROOT level would flood the debug file with jax/asyncio internals
+    # (megabytes per XLA compile). Third-party records still reach the
+    # files at their default WARNING+ effective level.
+    logging.getLogger("p2pfl_tpu").setLevel(logging.DEBUG)
     marker = f"p2pfl-node-{directory}-{idx}"
     if any(getattr(h, "_p2pfl_marker", None) == marker for h in root.handlers):
         return directory

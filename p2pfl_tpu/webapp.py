@@ -37,7 +37,7 @@ from urllib.parse import unquote
 from p2pfl_tpu.utils.monitor import (
     DEFAULT_LIVENESS_S,
     read_statuses,
-    render_html,
+    render_table_html,
 )
 
 _STYLE = """
@@ -194,9 +194,7 @@ class DashboardHandler(BaseHTTPRequestHandler):
         if safe is None or not safe.is_dir():
             return self._send(_page("not found", "<p>404</p>"), code=404)
         statuses = read_statuses(safe / "status")
-        table = render_html(statuses)
-        # reuse only the table body of render_html inside our page
-        inner = table.split("<body>")[1].split("</body>")[0]
+        inner = render_table_html(statuses)
         logs = sorted((safe / "logs").glob("*.log")) if (
             safe / "logs").is_dir() else []
         links = " | ".join(

@@ -89,10 +89,11 @@ def test_compose_generation_and_cleanup(tmp_path):
         assert f"--node\", \"{i}\"" in text
     assert "--tls-dir" in text
     assert text.count("build: .") == 3
-    # cleanup renders container + port kills without executing
+    # cleanup renders container removal without executing; no host
+    # port kills (ports live inside container namespaces)
     cmds = cleanup(cfg, dry_run=True)
     assert any("docker rm -f dep-node0" in c for c in cmds)
-    assert any("fuser -k" in c for c in cmds)
+    assert not any("fuser" in c for c in cmds)
 
 
 def test_compose_cli(tmp_path, capsys):

@@ -78,6 +78,14 @@ class Membership(Observable):
                 self.notify(Events.NODE_DIED, {"node": node, "t": t})
         return self.alive.copy()
 
+    def evict(self, node: int) -> None:
+        """Explicit departure (a STOP announcement): immediate eviction
+        instead of waiting out the heartbeat timeout."""
+        self.beating[node] = False
+        if self.alive[node]:
+            self.alive[node] = False
+            self.notify(Events.NODE_DIED, {"node": node, "t": self.clock})
+
     def get_nodes(self) -> list[int]:
         """Current members (heartbeater.get_nodes analog)."""
         return [int(i) for i in np.flatnonzero(self.alive)]

@@ -135,7 +135,13 @@ async def _run_node(cfg: ScenarioConfig, idx: int, ports: list[int],
         node.set_start_learning(cfg.training.rounds,
                                 cfg.training.epochs_per_round)
     await asyncio.wait_for(node.finished.wait(), timeout=600)
-    metrics = learner.evaluate()
+    # the learning loop already evaluated and recorded its own metrics
+    # (the METRICS flood) — don't evaluate twice
+    own = node.peer_metrics.get(idx)
+    metrics = (
+        {k: v for k, v in own.items() if k != "round"}
+        if own is not None else learner.evaluate()
+    )
     if status_task is not None:
         status_task.cancel()
         publish_status(

@@ -114,6 +114,10 @@ class P2PNode:
         self.peers: dict[int, PeerState] = {}
         self.progress: dict[int, NodeProgress] = {}
         self.peer_roles: dict[int, str] = {}
+        # flooded evaluation metrics per node (METRICS messages — the
+        # reference defines the type but stubs the handler,
+        # node.py:875-878; here they feed monitoring)
+        self.peer_metrics: dict[int, dict[str, Any]] = {}
         # capacity scales with federation size: BEATs from every node
         # share this ring, and 100 ids evict before a flood quiesces
         # once ~100 gossip ids are in flight per eviction window
@@ -155,6 +159,14 @@ class P2PNode:
         self._tasks.append(asyncio.create_task(self._heartbeat_loop()))
 
     async def stop(self) -> None:
+        # announce departure so peers drop us immediately instead of
+        # waiting out the heartbeat timeout (Stop_cmd semantics);
+        # time-bounded — a peer with a full TCP send buffer must not
+        # wedge our own shutdown on drain()
+        with contextlib.suppress(Exception):
+            await asyncio.wait_for(
+                self.broadcast(Message(MsgType.STOP, self.idx)), timeout=1.0
+            )
         for t in [self._learn_task, *self._tasks]:
             if t is not None:
                 t.cancel()
@@ -237,6 +249,21 @@ class P2PNode:
                 )
         elif t is MsgType.STOP_LEARNING:
             self._stop_learning()
+        elif t is MsgType.METRICS:
+            self.peer_metrics[msg.sender] = dict(msg.body)
+        elif t is MsgType.STOP:
+            # msg.sender left the federation (Stop_cmd semantics):
+            # evict everywhere — membership (no timeout wait), progress
+            # (round barriers), and the direct connection if one exists
+            gone_id = int(msg.sender)
+            self.membership.evict(gone_id)
+            self.progress.pop(gone_id, None)
+            self.peer_roles.pop(gone_id, None)
+            conn = self.peers.pop(gone_id, None)
+            if conn is not None:
+                if conn.reader_task:
+                    conn.reader_task.cancel()
+                conn.writer.close()
         elif t is MsgType.PARAMS:
             await self._on_params(peer, msg)
         elif t is MsgType.MODELS_AGGREGATED:
@@ -492,6 +519,19 @@ class P2PNode:
                 await asyncio.sleep(self.gossip_period_s)
         while self.round < self.total_rounds:
             await self._train_round()
+        # final evaluation, shared with the federation (the metrics
+        # flood the reference stubbed out, node.py:611-620 + 875-878)
+        try:
+            metrics = await asyncio.get_running_loop().run_in_executor(
+                None, self.learner.evaluate
+            )
+            self.peer_metrics[self.idx] = {"round": self.round, **metrics}
+            await self.broadcast(
+                Message(MsgType.METRICS, self.idx,
+                        {"round": self.round, **metrics})
+            )
+        except Exception:  # evaluation is best-effort reporting
+            log.exception("node %d final evaluate failed", self.idx)
         self.learning = False
         self.finished.set()
 

@@ -46,9 +46,11 @@ class MsgType(enum.Enum):
     MODELS_READY = "models_ready"
     MODELS_AGGREGATED = "models_aggregated"
     MODEL_INITIALIZED = "model_initialized"
+    # "node X left" must reach everyone, not just direct peers, or
+    # multi-hop members stall at the round barrier until the timeout
+    STOP = "stop"
     # direct messages
     CONNECT = "connect"
-    STOP = "stop"
     PARAMS = "params"
 
 
@@ -64,6 +66,7 @@ GOSSIPED = frozenset(
         MsgType.MODELS_READY,
         MsgType.MODELS_AGGREGATED,
         MsgType.MODEL_INITIALIZED,
+        MsgType.STOP,
     }
 )
 

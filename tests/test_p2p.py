@@ -161,6 +161,19 @@ def test_dfl_socket_federation_converges():
             np.testing.assert_allclose(p0, p2, rtol=1e-4, atol=1e-5)
             acc = nodes[1].learner.evaluate()["accuracy"]
             assert acc > 0.5, acc
+            # final METRICS flood: give the last broadcasts a moment,
+            # then every node should hold every node's evaluation
+            deadline = asyncio.get_event_loop().time() + 5
+            while (
+                any(len(node.peer_metrics) < 3 for node in nodes)
+                and asyncio.get_event_loop().time() < deadline
+            ):
+                await asyncio.sleep(0.05)
+            assert all(len(node.peer_metrics) == 3 for node in nodes)
+            assert all(
+                0.0 <= m["accuracy"] <= 1.0
+                for node in nodes for m in node.peer_metrics.values()
+            )
         finally:
             for node in nodes:
                 await node.stop()
@@ -337,6 +350,45 @@ def test_proxy_bridges_disconnected_trainers():
             np.testing.assert_allclose(k0, k2, rtol=1e-4, atol=1e-5)
         finally:
             for node in nodes:
+                await node.stop()
+
+    asyncio.run(main())
+
+
+def test_stop_announcement_evicts_immediately():
+    """A STOP flood must evict the departing node from membership,
+    progress, and connections at once — no heartbeat-timeout wait
+    (Stop_cmd semantics; the barrier reads membership)."""
+
+    async def main():
+        n = 3
+        fed, learners = _make_learners(n)
+        nodes = [
+            P2PNode(i, learners[i], role="aggregator", n_nodes=n,
+                    protocol=_PROTO, gossip_period_s=0.02)
+            for i in range(n)
+        ]
+        for node in nodes:
+            await node.start()
+        # ring wiring: 0-1, 1-2 — node 0 has NO direct link to node 2,
+        # so the eviction must arrive via the flood
+        await nodes[0].connect_to(nodes[1].host, nodes[1].port)
+        await nodes[1].connect_to(nodes[2].host, nodes[2].port)
+        await asyncio.sleep(0.5)  # beats flood; everyone sees everyone
+        assert set(nodes[0].membership.get_nodes()) == {0, 1, 2}
+        try:
+            await nodes[2].stop()
+            deadline = asyncio.get_event_loop().time() + 5
+            while (
+                2 in nodes[0].membership.get_nodes()
+                and asyncio.get_event_loop().time() < deadline
+            ):
+                await asyncio.sleep(0.02)
+            assert 2 not in nodes[0].membership.get_nodes()
+            assert 2 not in nodes[1].membership.get_nodes()
+            assert 2 not in nodes[1].peers
+        finally:
+            for node in nodes[:2]:
                 await node.stop()
 
     asyncio.run(main())

@@ -39,9 +39,15 @@ class Membership(Observable):
         self.last_seen = np.zeros(n_nodes, np.float64)
         self.beating = np.ones(n_nodes, bool)  # currently emitting beats
         self.alive = np.ones(n_nodes, bool)  # membership view
+        self.departed = np.zeros(n_nodes, bool)  # explicit STOP leavers
         self.clock = 0.0
 
     def beat(self, node: int, t: float | None = None) -> None:
+        if self.departed[node]:
+            # a straggler heartbeat (in flight when the STOP flood
+            # landed) must not resurrect an explicitly departed node —
+            # only a recover fault / rejoin clears the flag
+            return
         t = self.clock if t is None else t
         self.last_seen[node] = t
         if not self.alive[node]:
@@ -52,6 +58,7 @@ class Membership(Observable):
         if fault.kind == "crash":
             self.beating[fault.node] = False
         elif fault.kind == "recover":
+            self.departed[fault.node] = False
             self.beating[fault.node] = True
             self.beat(fault.node)
         else:
@@ -80,7 +87,9 @@ class Membership(Observable):
 
     def evict(self, node: int) -> None:
         """Explicit departure (a STOP announcement): immediate eviction
-        instead of waiting out the heartbeat timeout."""
+        instead of waiting out the heartbeat timeout, sticky against
+        straggler beats."""
+        self.departed[node] = True
         self.beating[node] = False
         if self.alive[node]:
             self.alive[node] = False

@@ -160,13 +160,22 @@ class P2PNode:
 
     async def stop(self) -> None:
         # announce departure so peers drop us immediately instead of
-        # waiting out the heartbeat timeout (Stop_cmd semantics);
-        # time-bounded — a peer with a full TCP send buffer must not
-        # wedge our own shutdown on drain()
-        with contextlib.suppress(Exception):
-            await asyncio.wait_for(
-                self.broadcast(Message(MsgType.STOP, self.idx)), timeout=1.0
-            )
+        # waiting out the heartbeat timeout (Stop_cmd semantics).
+        # Per-peer time bound, sent concurrently: one peer with a full
+        # TCP send buffer must neither wedge our shutdown on drain()
+        # nor starve the announcement to the healthy peers behind it.
+        stop_msg = Message(MsgType.STOP, self.idx)
+        self.dedup.check_and_add(stop_msg.msg_id)
+
+        async def announce(peer: PeerState) -> None:
+            with contextlib.suppress(Exception):
+                await asyncio.wait_for(
+                    write_message(peer.writer, stop_msg), timeout=1.0
+                )
+
+        await asyncio.gather(
+            *(announce(p) for p in list(self.peers.values()))
+        )
         for t in [self._learn_task, *self._tasks]:
             if t is not None:
                 t.cancel()
@@ -232,6 +241,10 @@ class P2PNode:
             self.peers.pop(peer.idx, None)
 
     async def _dispatch(self, peer: PeerState, msg: Message) -> None:
+        if not (0 <= msg.sender < self.n_nodes):
+            # wire-supplied index guards every handler that indexes
+            # membership/progress arrays — and garbage isn't forwarded
+            return
         if msg.type in GOSSIPED:
             if not self.dedup.check_and_add(msg.msg_id):
                 return  # already processed — at-most-once

@@ -227,7 +227,48 @@ class P2PNode:
         peer.reader_task = asyncio.create_task(self._read_loop(peer, reader))
         self.peers[idx] = peer
         self.membership.beat(idx)
+        # tracked: protects against task GC and lets stop() cancel a
+        # sync still draining a large init-weights write
+        self._tasks.append(asyncio.create_task(self._sync_peer(peer)))
         return peer
+
+    async def _sync_peer(self, peer: PeerState) -> None:
+        """Bring a NEW connection up to date with sticky state it may
+        have missed as a one-shot flood — the deterministic replacement
+        for the reference's paced Gossiper re-broadcast thread
+        (gossiper.py:66-112): a late joiner learns our role, that
+        learning is underway, and our round progress immediately."""
+
+        async def send(msg: Message) -> None:
+            # register our own msg_id first (as broadcast() does) so
+            # the flood can't echo back and be re-processed/re-forwarded
+            self.dedup.check_and_add(msg.msg_id)
+            await write_message(peer.writer, msg)
+
+        try:
+            await send(Message(MsgType.ROLE, self.idx, {"role": self.role}))
+            if self.learning:
+                await send(
+                    Message(MsgType.START_LEARNING, self.idx,
+                            {"rounds": self.total_rounds,
+                             "epochs": self.epochs,
+                             "leader": self.leader})
+                )
+                if self.initialized:
+                    await send(Message(MsgType.MODEL_INITIALIZED, self.idx))
+                    # a joiner that missed the initial diffusion gets
+                    # the weights directly (diffusion loops have long
+                    # exited by now)
+                    await self._send_params(
+                        peer, self.learner.get_parameters(), (), 1,
+                        init=True,
+                    )
+                await send(
+                    Message(MsgType.MODELS_READY, self.idx,
+                            {"round": self.round})
+                )
+        except (ConnectionError, RuntimeError):
+            self.peers.pop(peer.idx, None)
 
     # ------------------------------------------------------------------
     # receive path
@@ -386,9 +427,18 @@ class P2PNode:
     # ------------------------------------------------------------------
     async def _heartbeat_loop(self) -> None:
         period = self.protocol.heartbeat_period_s
+        beats = 0
         while True:
             self.membership.beat(self.idx)
             await self.broadcast(Message(MsgType.BEAT, self.idx))
+            beats += 1
+            if beats % 2 == 0:
+                # role refresh every 2nd beat (heartbeater.py:66-78
+                # SEND_ROLE cadence) — keeps role views converged even
+                # if the initial ROLE flood was missed
+                await self.broadcast(
+                    Message(MsgType.ROLE, self.idx, {"role": self.role})
+                )
             self.membership.advance_to(self.membership.clock + period)
             await asyncio.sleep(period)
 

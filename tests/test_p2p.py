@@ -269,9 +269,11 @@ def test_train_set_vote_caps_participants():
 
     async def main():
         n = 5
+        # generous timeouts: 5 in-process federations share one CPU and
+        # a loaded CI host can stretch fits past a tight coverage window
         proto = ProtocolConfig(heartbeat_period_s=0.2,
-                               aggregation_timeout_s=20.0,
-                               vote_timeout_s=5.0, train_set_size=3)
+                               aggregation_timeout_s=45.0,
+                               vote_timeout_s=10.0, train_set_size=3)
         fed, learners = _make_learners(n)
         nodes = [
             P2PNode(i, learners[i], role="aggregator", n_nodes=n,
@@ -351,6 +353,60 @@ def test_proxy_bridges_disconnected_trainers():
         finally:
             for node in nodes:
                 await node.stop()
+
+    asyncio.run(main())
+
+
+def test_late_joiner_receives_state_sync():
+    """A peer that connects AFTER the one-shot floods must still learn
+    the sticky state: role, learning-in-progress, initial weights, and
+    round progress (the reference covers this with its paced Gossiper
+    re-broadcast thread, gossiper.py:66-112)."""
+
+    async def main():
+        fed, learners = _make_learners(2)
+        a = P2PNode(0, learners[0], role="aggregator", n_nodes=2,
+                    protocol=_PROTO, gossip_period_s=0.02)
+        b = P2PNode(1, learners[1], role="trainer", n_nodes=2,
+                    protocol=_PROTO, gossip_period_s=0.02)
+        await a.start()
+        await b.start()
+        # A is mid-learning before B ever connects
+        a.learner.init()
+        a.learning = True
+        a.initialized = True
+        a.total_rounds = 5
+        a.epochs = 2
+        a.leader = 0
+        a.round = 3
+        try:
+            await b.connect_to(a.host, a.port)
+            deadline = asyncio.get_event_loop().time() + 5
+            while (
+                not (
+                    b.learning and b.initialized
+                    and 0 in b.progress
+                    and b.progress[0].ready_round == 3
+                )
+                and asyncio.get_event_loop().time() < deadline
+            ):
+                await asyncio.sleep(0.02)
+            assert b.learning and b.total_rounds == 5 and b.epochs == 2
+            assert b.leader == 0
+            assert b.initialized  # weights arrived, not just the flag
+            assert b.peer_roles.get(0) == "aggregator"
+            assert b.progress[0].ready_round == 3
+            np.testing.assert_array_equal(
+                np.asarray(
+                    b.learner.get_parameters()["params"]["Dense_0"]["kernel"]
+                ),
+                np.asarray(
+                    a.learner.get_parameters()["params"]["Dense_0"]["kernel"]
+                ),
+            )
+        finally:
+            await a.stop()
+            await b.stop()
 
     asyncio.run(main())
 

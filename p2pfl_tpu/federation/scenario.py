@@ -97,6 +97,18 @@ class Scenario(Observable):
         self.membership = Membership(n, config.protocol)
         self.logger = MetricsLogger(config.log_dir, config.name,
                                     tensorboard=config.tensorboard)
+        if self.logger.dir is not None:
+            # topology render next to the metrics (controller.py:301 /
+            # monitoring-map analog) — best-effort: a render/save
+            # failure must never abort the run for an optional PNG
+            try:
+                from p2pfl_tpu.utils.draw import draw_topology
+
+                draw_topology(self.topology,
+                              self.logger.dir / "topology.png",
+                              roles=self.roles)
+            except Exception:
+                pass
         self.transport = MeshTransport(n)
         self.leader = next(
             (i for i, nc in enumerate(config.nodes)

@@ -228,8 +228,13 @@ class P2PNode:
         self.peers[idx] = peer
         self.membership.beat(idx)
         # tracked: protects against task GC and lets stop() cancel a
-        # sync still draining a large init-weights write
-        self._tasks.append(asyncio.create_task(self._sync_peer(peer)))
+        # sync still draining a large init-weights write; pruned on
+        # completion so reconnect churn doesn't accumulate dead tasks
+        task = asyncio.create_task(self._sync_peer(peer))
+        self._tasks.append(task)
+        task.add_done_callback(
+            lambda t: self._tasks.remove(t) if t in self._tasks else None
+        )
         return peer
 
     async def _sync_peer(self, peer: PeerState) -> None:
@@ -268,18 +273,25 @@ class P2PNode:
                             {"round": self.round})
                 )
         except (ConnectionError, RuntimeError):
-            self.peers.pop(peer.idx, None)
+            self._drop_conn(peer)
 
     # ------------------------------------------------------------------
     # receive path
     # ------------------------------------------------------------------
+    def _drop_conn(self, peer: PeerState) -> None:
+        """Remove a dead connection — but only if it is STILL the
+        registered one; a redialed replacement must not be evicted by
+        the old connection's dying task."""
+        if self.peers.get(peer.idx) is peer:
+            self.peers.pop(peer.idx, None)
+
     async def _read_loop(self, peer: PeerState, reader) -> None:
         try:
             while True:
                 msg = await read_message(reader)
                 await self._dispatch(peer, msg)
         except (asyncio.IncompleteReadError, ConnectionError, ValueError):
-            self.peers.pop(peer.idx, None)
+            self._drop_conn(peer)
 
     async def _dispatch(self, peer: PeerState, msg: Message) -> None:
         if not (0 <= msg.sender < self.n_nodes):
@@ -327,7 +339,11 @@ class P2PNode:
         elif t is MsgType.MODEL_INITIALIZED:
             self._progress(msg.sender).initialized = True
         elif t is MsgType.MODELS_READY:
-            self._progress(msg.sender).ready_round = int(msg.body["round"])
+            pr = self._progress(msg.sender)
+            # monotonic: flood paths can deliver an older snapshot (a
+            # relayed _sync_peer message) after a newer one — a
+            # regression would re-block the round barrier
+            pr.ready_round = max(pr.ready_round, int(msg.body["round"]))
         elif t is MsgType.VOTE_TRAIN_SET:
             r = int(msg.body["round"])
             if r >= self.round:  # stale-round ballots are dead voters
@@ -405,7 +421,7 @@ class P2PNode:
             try:
                 await write_message(peer.writer, msg)
             except (ConnectionError, RuntimeError):
-                self.peers.pop(peer.idx, None)
+                self._drop_conn(peer)
 
     async def _send_params(self, peer: PeerState, params, contributors,
                            weight, **body) -> None:
@@ -420,7 +436,7 @@ class P2PNode:
                         msg_id=secrets.token_hex(8)),
             )
         except (ConnectionError, RuntimeError):
-            self.peers.pop(peer.idx, None)
+            self._drop_conn(peer)
 
     # ------------------------------------------------------------------
     # control plane loops

@@ -169,6 +169,10 @@ class DashboardHandler(BaseHTTPRequestHandler):
                 return self._json(tail_metrics(self.root, parts[2]))
         if len(parts) == 2 and parts[0] == "scenario":
             return self._scenario(parts[1])
+        if len(parts) == 2 and parts[0] == "topology":
+            path = self._safe_child(parts[1], "topology.png")
+            if path is not None and path.is_file():
+                return self._send(path.read_bytes(), "image/png")
         if len(parts) == 3 and parts[0] == "logs":
             return self._logfile(parts[1], parts[2])
         self._send(_page("not found", "<p>404</p>"), code=404)
@@ -207,6 +211,11 @@ class DashboardHandler(BaseHTTPRequestHandler):
             + (f" | logs: {links}" if links else "")
             + "</p>"
         )
+        if (safe / "topology.png").is_file():
+            body += (
+                f"<p><img src='/topology/{html.escape(name)}' "
+                "alt='topology' style='max-width:480px'></p>"
+            )
         self._send(_page(f"scenario {html.escape(name)}", body, refresh=2))
 
     def _logfile(self, name: str, fname: str) -> None:

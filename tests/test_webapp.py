@@ -87,6 +87,27 @@ def test_log_viewer_and_404s(server):
         assert status == 404, path
 
 
+def test_topology_image_served(tmp_path):
+    """A scenario's rendered topology.png is served and linked from the
+    scenario page (the monitoring map analog, webserver/app.py:367+)."""
+    png = b"\x89PNG\r\n\x1a\nfake"
+    (tmp_path / "beta" / "status").mkdir(parents=True)
+    publish_status(tmp_path / "beta" / "status", 0, {"role": "trainer"})
+    (tmp_path / "beta" / "topology.png").write_bytes(png)
+    srv = make_server(tmp_path, port=0)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    base = f"http://127.0.0.1:{srv.server_address[1]}"
+    try:
+        with urllib.request.urlopen(base + "/topology/beta", timeout=10) as r:
+            assert r.headers["Content-Type"] == "image/png"
+            assert r.read() == png
+        _, page = _get(base + "/scenario/beta")
+        assert "/topology/beta" in page
+    finally:
+        srv.shutdown()
+
+
 def test_traversal_refused(server):
     import urllib.error
 

@@ -75,6 +75,25 @@ def test_gradients_match_reference():
                                    rtol=2e-4, atol=2e-4)
 
 
+def test_gradients_cross_lengths():
+    """Asymmetric sq/sk exercises both backward kernels' streaming
+    (dq streams K/V blocks; dk/dv streams Q/dO blocks)."""
+    ks = jax.random.split(jax.random.PRNGKey(7), 3)
+    q = jax.random.normal(ks[0], (2, 128, 3, 64))
+    k = jax.random.normal(ks[1], (2, 384, 3, 64))
+    v = jax.random.normal(ks[2], (2, 384, 3, 64))
+
+    def loss(fn):
+        return lambda q, k, v: jnp.sum(jnp.tanh(fn(q, k, v)))
+
+    gf = jax.grad(loss(lambda *a: flash_attention(*a, interpret=True)),
+                  argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss(reference_attention), argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-4)
+
+
 def test_vit_use_flash_trains():
     """ViT with the Pallas local-attention path must init and take a
     gradient step (custom VJP wired through flax)."""

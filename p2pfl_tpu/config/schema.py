@@ -137,6 +137,9 @@ class ScenarioConfig:
     # TensorBoard event files alongside JSONL/CSV (tracking_args
     # analog; needs log_dir)
     tensorboard: bool = False
+    # W&B remote tracking (tracking_args.enable_remote_tracking /
+    # remotelogger.py analog; requires the wandb client installed)
+    wandb: bool = False
     # jax.profiler trace of one steady-state round lands here
     # (SURVEY §5.1: the reference has no profiler at all)
     profile_dir: str | None = None

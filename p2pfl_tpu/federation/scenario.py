@@ -96,7 +96,8 @@ class Scenario(Observable):
         self.roles = [nc.role for nc in config.nodes]
         self.membership = Membership(n, config.protocol)
         self.logger = MetricsLogger(config.log_dir, config.name,
-                                    tensorboard=config.tensorboard)
+                                    tensorboard=config.tensorboard,
+                                    wandb=config.wandb)
         if self.logger.dir is not None:
             # topology render next to the metrics (controller.py:301 /
             # monitoring-map analog) — best-effort: a render/save

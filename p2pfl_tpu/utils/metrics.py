@@ -31,17 +31,28 @@ class MetricsLogger:
     """
 
     def __init__(self, log_dir: str | pathlib.Path | None, name: str = "scenario",
-                 tensorboard: bool = False):
+                 tensorboard: bool = False, wandb: bool = False,
+                 wandb_kwargs: dict | None = None):
         self.enabled = log_dir is not None
         self.name = name
         self._csv_files: dict[int, Any] = {}
         self._csv_writers: dict[int, Any] = {}
         self._tb_writers: dict[Any, Any] = {}
         self._tensorboard = tensorboard and self.enabled
+        self._wandb_run = None
         if self._tensorboard:
             # fail FAST at construction, not mid-run after training
             # compute was spent
             from torch.utils.tensorboard import SummaryWriter  # noqa: F401
+        if wandb:
+            # remote tracking (the reference's remotelogger.py W&B
+            # fork, selected by tracking_args.enable_remote_tracking);
+            # fail fast if the client isn't installed
+            import wandb as _wandb
+
+            self._wandb_run = _wandb.init(
+                project="p2pfl_tpu", name=name, **(wandb_kwargs or {})
+            )
         self.history: list[dict] = []  # in-memory view for tests/benchmarks
         if self.enabled:
             self.dir = pathlib.Path(log_dir) / name
@@ -61,6 +72,15 @@ class MetricsLogger:
             **{k: float(v) for k, v in metrics.items()},
         }
         self.history.append(rec)
+        if self._wandb_run is not None:
+            # remote tracking is independent of the local log_dir —
+            # one W&B run per scenario; node metrics namespaced the way
+            # the reference's logger prefixes participant names
+            prefix = "" if node is None else f"node_{node}/"
+            self._wandb_run.log(
+                {f"{prefix}{k}": float(v) for k, v in metrics.items()},
+                step=int(step),
+            )
         if not self.enabled:
             return
         self._jsonl.write(json.dumps(rec) + "\n")
@@ -113,3 +133,5 @@ class MetricsLogger:
             f.close()
         for w in self._tb_writers.values():
             w.close()
+        if self._wandb_run is not None:
+            self._wandb_run.finish()

@@ -28,6 +28,40 @@ def test_tensorboard_backend_writes_event_files(tmp_path):
     assert (tmp_path / "tb-test" / "metrics.jsonl").exists()
 
 
+def test_wandb_backend_gated(tmp_path, monkeypatch):
+    """The W&B backend (remotelogger.py parity) duck-types the client:
+    one run per scenario, node metrics namespaced, finish on close;
+    absent client fails fast at construction."""
+    import sys
+    import types
+
+    calls = {"logs": [], "finished": False}
+
+    class FakeRun:
+        def log(self, metrics, step=None):
+            calls["logs"].append((metrics, step))
+
+        def finish(self):
+            calls["finished"] = True
+
+    fake = types.ModuleType("wandb")
+    fake.init = lambda **kw: (calls.setdefault("init", kw), FakeRun())[1]
+    monkeypatch.setitem(sys.modules, "wandb", fake)
+    ml = MetricsLogger(tmp_path, "wb", wandb=True)
+    ml.log_metrics({"Train/loss": 1.0}, step=3, round=0, node=2)
+    ml.log_metrics({"Test/mean_accuracy": 0.5}, step=3, round=0)
+    ml.close()
+    assert calls["init"]["project"] == "p2pfl_tpu"
+    assert ({"node_2/Train/loss": 1.0}, 3) in calls["logs"]
+    assert ({"Test/mean_accuracy": 0.5}, 3) in calls["logs"]
+    assert calls["finished"]
+    # fail-fast without the client (None in sys.modules blocks the
+    # import even on machines where a real wandb IS installed)
+    monkeypatch.setitem(sys.modules, "wandb", None)
+    with pytest.raises(ImportError):
+        MetricsLogger(tmp_path, "wb2", wandb=True)
+
+
 def test_per_node_log_files(tmp_path):
     logdir = setup_node_logging(tmp_path, "s", 3, console=False)
     log = logging.getLogger("p2pfl_tpu.test")

@@ -15,7 +15,6 @@ from __future__ import annotations
 
 import argparse
 import json
-import pathlib
 import sys
 
 from p2pfl_tpu.config.schema import (
@@ -25,7 +24,6 @@ from p2pfl_tpu.config.schema import (
     TrainingConfig,
 )
 from p2pfl_tpu.federation.scenario import Scenario
-from p2pfl_tpu.utils.draw import draw_topology
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -54,6 +52,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--log-dir", default=None)
     p.add_argument("--checkpoint-dir", default=None)
     p.add_argument("--checkpoint-every", type=int, default=0)
+    p.add_argument("--transport", choices=["auto", "dense", "sparse"],
+                   default="auto",
+                   help="weight-exchange collective schedule")
+    p.add_argument("--tensorboard", action="store_true",
+                   help="also write TensorBoard event files (needs --log-dir)")
+    p.add_argument("--wandb", action="store_true",
+                   help="mirror metrics to a Weights & Biases run")
+    p.add_argument("--profile-dir", default=None,
+                   help="jax.profiler trace of one steady-state round")
     p.add_argument("--save-config", default=None,
                    help="write the effective scenario JSON here and exit")
     return p
@@ -80,6 +87,10 @@ def config_from_args(args: argparse.Namespace) -> ScenarioConfig:
         log_dir=args.log_dir,
         checkpoint_dir=args.checkpoint_dir,
         checkpoint_every=args.checkpoint_every,
+        transport=args.transport,
+        tensorboard=args.tensorboard,
+        wandb=args.wandb,
+        profile_dir=args.profile_dir,
     )
 
 
@@ -90,11 +101,8 @@ def main(argv: list[str] | None = None) -> int:
         cfg.save(args.save_config)
         print(f"wrote {args.save_config}")
         return 0
+    # Scenario renders the topology PNG itself when log_dir is set
     scenario = Scenario(cfg)
-    if cfg.log_dir:
-        draw_topology(scenario.topology,
-                      pathlib.Path(cfg.log_dir) / cfg.name / "topology.png",
-                      scenario.roles)
     result = scenario.run(target_accuracy=args.target_accuracy)
     scenario.close()
     out = {

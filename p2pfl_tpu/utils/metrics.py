@@ -31,8 +31,7 @@ class MetricsLogger:
     """
 
     def __init__(self, log_dir: str | pathlib.Path | None, name: str = "scenario",
-                 tensorboard: bool = False, wandb: bool = False,
-                 wandb_kwargs: dict | None = None):
+                 tensorboard: bool = False, wandb: bool = False):
         self.enabled = log_dir is not None
         self.name = name
         self._csv_files: dict[int, Any] = {}
@@ -50,9 +49,7 @@ class MetricsLogger:
             # fail fast if the client isn't installed
             import wandb as _wandb
 
-            self._wandb_run = _wandb.init(
-                project="p2pfl_tpu", name=name, **(wandb_kwargs or {})
-            )
+            self._wandb_run = _wandb.init(project="p2pfl_tpu", name=name)
         self.history: list[dict] = []  # in-memory view for tests/benchmarks
         if self.enabled:
             self.dir = pathlib.Path(log_dir) / name

@@ -95,7 +95,12 @@ def config_from_args(args: argparse.Namespace) -> ScenarioConfig:
 
 
 def main(argv: list[str] | None = None) -> int:
-    args = build_parser().parse_args(argv)
+    parser = build_parser()
+    args = parser.parse_args(argv)
+    if args.tensorboard and not args.log_dir and not args.config:
+        # surface the misconfiguration before any compute is spent —
+        # the logger would otherwise silently no-op the flag
+        parser.error("--tensorboard requires --log-dir")
     cfg = config_from_args(args)
     if args.save_config:
         cfg.save(args.save_config)

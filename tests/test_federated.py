@@ -133,6 +133,47 @@ def test_krum_round_runs(setup):
     assert np.isfinite(np.asarray(metrics["train_loss"])).all()
 
 
+def test_byzantine_node_krum_resists_poison(setup):
+    """Robust aggregation end-to-end: one node's params are poisoned
+    (huge values) before the round; Krum must keep survivors' models
+    finite and learning, while FedAvg is visibly contaminated."""
+    ds, fns, tr, data, xt, yt = setup
+    topo = generate_topology("fully", N)
+    plan = make_round_plan(topo, ["aggregator"] * N, "DFL")
+    eval_fn = tr.compile_eval(build_eval_fn(fns))
+
+    def poison(fed, node=2, value=1e6):
+        params = jax.tree.map(np.asarray, fed.states.params)
+        params = jax.tree.map(
+            lambda p: np.concatenate(
+                [p[:node], np.full_like(p[node:node + 1], value),
+                 p[node + 1:]]
+            ),
+            params,
+        )
+        return fed.replace(
+            states=fed.states.replace(params=tr.put_stacked(params))
+        )
+
+    results = {}
+    for name, agg in (("krum", Krum(f=1)), ("fedavg", None)):
+        fed = tr.put_stacked(init_federation(fns, data[0][0, :1], N))
+        round_fn = tr.compile_round(
+            build_round_fn(fns, aggregator=agg, epochs=1)
+        )
+        fed, _ = round_fn(fed, *data, *_plan_args(tr, plan))
+        fed = poison(fed)
+        fed, _ = round_fn(fed, *data, *_plan_args(tr, plan))
+        acc = np.asarray(eval_fn(fed, xt, yt)["accuracy"])
+        results[name] = acc
+    # Krum: every honest node selected a clean model — finite and usable
+    honest = [i for i in range(N) if i != 2]
+    assert np.isfinite(results["krum"][honest]).all()
+    assert results["krum"][honest].mean() > 0.5, results["krum"]
+    # FedAvg mixes the poison into every neighborhood mean
+    assert results["fedavg"][honest].mean() < 0.3, results["fedavg"]
+
+
 def test_ring_topology_converges_slower_but_learns(setup):
     ds, fns, tr, data, xt, yt = setup
     topo = generate_topology("ring", N)

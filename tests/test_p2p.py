@@ -125,11 +125,12 @@ _PROTO = ProtocolConfig(heartbeat_period_s=0.2, aggregation_timeout_s=20.0,
                         vote_timeout_s=5.0)
 
 
-async def _run_federation(roles, rounds=2, start_node=0):
+async def _run_federation(roles, rounds=2, start_node=0, proto=_PROTO,
+                          samples=150, timeout=120):
     n = len(roles)
-    fed, learners = _make_learners(n)
+    fed, learners = _make_learners(n, samples=samples)
     nodes = [
-        P2PNode(i, learners[i], role=roles[i], n_nodes=n, protocol=_PROTO,
+        P2PNode(i, learners[i], role=roles[i], n_nodes=n, protocol=proto,
                 gossip_period_s=0.02)
         for i in range(n)
     ]
@@ -141,7 +142,8 @@ async def _run_federation(roles, rounds=2, start_node=0):
     nodes[start_node].learner.init()
     nodes[start_node].set_start_learning(rounds=rounds, epochs=1)
     await asyncio.wait_for(
-        asyncio.gather(*(node.finished.wait() for node in nodes)), timeout=120
+        asyncio.gather(*(node.finished.wait() for node in nodes)),
+        timeout=timeout,
     )
     return fed, nodes
 
@@ -274,24 +276,10 @@ def test_train_set_vote_caps_participants():
         proto = ProtocolConfig(heartbeat_period_s=0.2,
                                aggregation_timeout_s=45.0,
                                vote_timeout_s=10.0, train_set_size=3)
-        fed, learners = _make_learners(n)
-        nodes = [
-            P2PNode(i, learners[i], role="aggregator", n_nodes=n,
-                    protocol=proto, gossip_period_s=0.02)
-            for i in range(n)
-        ]
-        for node in nodes:
-            await node.start()
-        for i in range(n):
-            for j in range(i + 1, n):
-                await nodes[i].connect_to(nodes[j].host, nodes[j].port)
-        nodes[0].learner.init()
-        nodes[0].set_start_learning(rounds=1, epochs=1)
+        fed, nodes = await _run_federation(
+            ["aggregator"] * n, rounds=1, proto=proto
+        )
         try:
-            await asyncio.wait_for(
-                asyncio.gather(*(node.finished.wait() for node in nodes)),
-                timeout=120,
-            )
             assert all(node.round == 1 for node in nodes)
             # fully connected, equal vouching: the tie-break elects the
             # three lowest indices; the last round's session still holds
@@ -471,6 +459,53 @@ def test_multiprocess_launch(tmp_path):
     assert len(res) == 2
     assert all(r["round"] == 1 for r in res)
     assert all(0.0 <= r["accuracy"] <= 1.0 for r in res)
+
+
+def test_eight_node_socket_federation_with_vote_cap():
+    """Scale smoke for the socket stack: 8 nodes, fully connected,
+    TRAIN_SET_SIZE=4 binding, 3 rounds — voting, partial-aggregation
+    gossip, the round barrier, and aggregate adoption past the small
+    fixtures. Three rounds make the ROTATING tie-break observable:
+    with equal vouch scores and leader 0 always seated, round 0 elects
+    {0,1,2,3}, round 1 re-elects {0,1,2,3} (leader displaces 4), and
+    round 2 elects {0,2,3,4} — the final coverage proves the train set
+    actually moved."""
+
+    async def main():
+        n = 8
+        proto = ProtocolConfig(heartbeat_period_s=0.3,
+                               aggregation_timeout_s=60.0,
+                               vote_timeout_s=15.0, train_set_size=4)
+        fed, nodes = await _run_federation(
+            ["aggregator"] * n, rounds=3, proto=proto, samples=120,
+            timeout=300,
+        )
+        try:
+            assert all(node.round == 3 for node in nodes)
+            # the LAST round's train set, rotated off the initial one —
+            # seated nodes covered exactly it; voted-out nodes adopted
+            # (waiting mode never populates the session store)
+            final_set = frozenset({0, 2, 3, 4})
+            for node in nodes:
+                expect = final_set if node.idx in final_set else frozenset()
+                assert node.session.covered == expect, (
+                    node.idx, sorted(node.session.covered)
+                )
+            # everyone ends on the starter-leader's final aggregate
+            k0 = np.asarray(
+                nodes[0].learner.get_parameters()["params"]["Dense_0"]["kernel"]
+            )
+            for other in (1, 4, 7):
+                ko = np.asarray(
+                    nodes[other].learner.get_parameters()
+                    ["params"]["Dense_0"]["kernel"]
+                )
+                np.testing.assert_allclose(k0, ko, rtol=1e-4, atol=1e-5)
+        finally:
+            for node in nodes:
+                await node.stop()
+
+    asyncio.run(main())
 
 
 def test_cfl_socket_federation_server_aggregates():

@@ -31,6 +31,7 @@ import dataclasses
 import logging
 import random
 import secrets
+import time
 from typing import Any
 
 from p2pfl_tpu.config.schema import ProtocolConfig
@@ -104,8 +105,20 @@ class P2PNode:
         self.gossip_period_s = gossip_period_s
         self.federation = federation
         # mutual TLS (p2pfl_tpu.p2p.tls.TLSCredentials) — replaces the
-        # reference's RSA/AES-ECB handshake (encrypter.py:48-193)
+        # reference's RSA/AES-ECB handshake (encrypter.py:48-193).
+        # With TLS on, every self-originated message is origin-signed
+        # and every received message's signature is checked against the
+        # scenario CA, so a valid member cannot forge another node's
+        # STOP / ballot / leadership transfer (see p2p.tls docstring).
         self.tls = tls
+        if tls is not None:
+            from p2pfl_tpu.p2p.tls import MessageSigner, MessageVerifier
+
+            self._signer = MessageSigner(tls)
+            self._verifier = MessageVerifier(tls.ca_cert)
+        else:
+            self._signer = None
+            self._verifier = None
         self._rng = random.Random(seed * 7919 + idx)
         self.session = AggregationSession(
             aggregator, timeout_s=self.protocol.aggregation_timeout_s
@@ -140,6 +153,9 @@ class P2PNode:
         # past the barrier) or outside an active round body — replayed
         # when this node's round body reaches them
         self._pending_params: list[tuple[PeerState, Message]] = []
+        # highest beat sequence seen per node (replay fence — see the
+        # BEAT handler)
+        self._beat_seen: dict[int, int] = {}
         self._round_active = False
         self._server: asyncio.Server | None = None
         self._tasks: list[asyncio.Task] = []
@@ -164,7 +180,7 @@ class P2PNode:
         # Per-peer time bound, sent concurrently: one peer with a full
         # TCP send buffer must neither wedge our shutdown on drain()
         # nor starve the announcement to the healthy peers behind it.
-        stop_msg = Message(MsgType.STOP, self.idx)
+        stop_msg = self._sign(Message(MsgType.STOP, self.idx))
         self.dedup.check_and_add(stop_msg.msg_id)
 
         async def announce(peer: PeerState) -> None:
@@ -195,6 +211,33 @@ class P2PNode:
             # NOT wait_closed(): on py3.12 it blocks until every peer
             # connection (including ones owned by other nodes) is gone
 
+    def _transport_idx(self, writer: asyncio.StreamWriter) -> int | None:
+        """The node index the connection's TLS certificate vouches for
+        (None on plaintext federations)."""
+        from p2pfl_tpu.p2p.tls import peer_index
+
+        return peer_index(writer.get_extra_info("peercert"))
+
+    def _hello_ok(self, hello: Message,
+                  writer: asyncio.StreamWriter) -> bool:
+        """CONNECT binding: with TLS on, the index claimed in the hello
+        must be the one in the connection's certificate CN — otherwise
+        member A could register a connection as member B and have every
+        direct frame on it attributed to B. The hello's origin
+        signature is checked too, binding its body (the dial-back port)
+        to the same identity."""
+        if self.tls is None:
+            return True
+        cert_idx = self._transport_idx(writer)
+        if (cert_idx is not None and cert_idx == int(hello.sender)
+                and self._verify_origin(hello)):
+            return True
+        log.warning(
+            "node %d rejecting CONNECT: hello claims %s but certificate "
+            "CN says %s", self.idx, hello.sender, cert_idx,
+        )
+        return False
+
     async def connect_to(self, host: str, port: int) -> None:
         """Dial a neighbor (base_node.py connect_to)."""
         reader, writer = await asyncio.open_connection(
@@ -202,9 +245,14 @@ class P2PNode:
             ssl=self.tls.client_context() if self.tls else None,
         )
         await write_message(
-            writer, Message(MsgType.CONNECT, self.idx, {"port": self.port})
+            writer,
+            self._sign(Message(MsgType.CONNECT, self.idx,
+                               {"port": self.port})),
         )
         hello = await read_message(reader)
+        if not self._hello_ok(hello, writer):
+            writer.close()
+            raise ConnectionError("peer hello does not match its certificate")
         peer = self._register_peer(int(hello.sender), reader, writer)
         log.debug("node %d connected to %d", self.idx, peer.idx)
 
@@ -214,11 +262,15 @@ class P2PNode:
         except (asyncio.IncompleteReadError, ValueError):
             writer.close()
             return
-        if hello.type is not MsgType.CONNECT:
+        if hello.type is not MsgType.CONNECT or not self._hello_ok(
+            hello, writer
+        ):
             writer.close()
             return
         await write_message(
-            writer, Message(MsgType.CONNECT, self.idx, {"port": self.port})
+            writer,
+            self._sign(Message(MsgType.CONNECT, self.idx,
+                               {"port": self.port})),
         )
         self._register_peer(int(hello.sender), reader, writer)
 
@@ -247,6 +299,7 @@ class P2PNode:
         async def send(msg: Message) -> None:
             # register our own msg_id first (as broadcast() does) so
             # the flood can't echo back and be re-processed/re-forwarded
+            self._sign(msg)
             self.dedup.check_and_add(msg.msg_id)
             await write_message(peer.writer, msg)
 
@@ -299,16 +352,37 @@ class P2PNode:
             # membership/progress arrays — and garbage isn't forwarded
             return
         if msg.type in GOSSIPED:
-            if not self.dedup.check_and_add(msg.msg_id):
+            # peek-dedup first (duplicates cost no crypto), verify,
+            # REGISTER ONLY WHAT VERIFIED. Registering before verifying
+            # would let a malicious relay poison an id: forward a
+            # corrupted copy of a mid-flood frame ahead of the honest
+            # paths and the genuine message gets dropped as a duplicate
+            # everywhere downstream — a one-member censorship primitive.
+            if self.dedup.seen(msg.msg_id):
                 return  # already processed — at-most-once
+            if not self._verify_origin(msg):
+                return  # forged: not processed, not forwarded, NOT seen
+            self.dedup.check_and_add(msg.msg_id)
             await self._forward(msg, exclude=peer.idx)
+        elif msg.type is MsgType.PARAMS and not self._verify_origin(msg):
+            return
         t = msg.type
         if t is MsgType.BEAT:
-            self.membership.beat(msg.sender)
+            # sequence fence: the beat counter rides inside the signed
+            # bytes, so a replayed BEAT (after its msg_id evicts from
+            # the bounded dedup ring) cannot keep a crashed node alive
+            # in membership — only strictly newer beats count
+            seq = int(msg.body.get("n", 0))
+            if seq > self._beat_seen.get(msg.sender, -1):
+                self._beat_seen[msg.sender] = seq
+                self.membership.beat(msg.sender)
         elif t is MsgType.ROLE:
             self.peer_roles[msg.sender] = msg.body["role"]
         elif t is MsgType.START_LEARNING:
-            if not self.learning:
+            # finished-run fence: a replayed genuine START_LEARNING
+            # must not restart a completed federation (and reset the
+            # leader/history from its stale body)
+            if not self.learning and not self.finished.is_set():
                 self._start_learning(
                     msg.body["rounds"], msg.body["epochs"],
                     leader=msg.body.get("leader"),
@@ -333,9 +407,17 @@ class P2PNode:
         elif t is MsgType.PARAMS:
             await self._on_params(peer, msg)
         elif t is MsgType.MODELS_AGGREGATED:
+            # monotonic like MODELS_READY: flood paths (and post-
+            # eviction replays) can deliver an older snapshot after a
+            # newer one; within a round coverage only grows, so stale
+            # rounds are ignored and same-round sets union
             pr = self._progress(msg.sender)
-            pr.models_aggregated = set(msg.body["contributors"])
-            pr.agg_round = int(msg.body.get("round", 0))
+            r = int(msg.body.get("round", 0))
+            if r > pr.agg_round:
+                pr.models_aggregated = set(msg.body["contributors"])
+                pr.agg_round = r
+            elif r == pr.agg_round:
+                pr.models_aggregated |= set(msg.body["contributors"])
         elif t is MsgType.MODEL_INITIALIZED:
             self._progress(msg.sender).initialized = True
         elif t is MsgType.MODELS_READY:
@@ -351,8 +433,13 @@ class P2PNode:
                     int(c) for c in msg.body["candidates"]
                 )
         elif t is MsgType.TRANSFER_LEADERSHIP:
-            self.leader = int(msg.body["to"])
-            self.leader_history.append(self.leader)
+            # round fencing: the dedup ring is bounded, so a recorded
+            # genuine transfer could be re-flooded rounds later after
+            # its id evicts — a stale token must not reset leadership
+            # (the body's round is inside the signed bytes)
+            if int(msg.body.get("round", self.round)) >= self.round:
+                self.leader = int(msg.body["to"])
+                self.leader_history.append(self.leader)
 
     async def _on_params(self, peer: PeerState, msg: Message) -> None:
         if msg.body.get("init"):
@@ -409,7 +496,32 @@ class P2PNode:
     # ------------------------------------------------------------------
     # send path
     # ------------------------------------------------------------------
+    def _sign(self, msg: Message) -> Message:
+        """Origin-sign a self-originated message (no-op without TLS).
+        Forwarded messages keep the ORIGIN's signature — only messages
+        this node creates pass through here."""
+        if self._signer is not None and not msg.sig:
+            msg.sig = self._signer.sign(msg.signing_bytes())
+            msg.cert = self._signer.cert_pem
+        return msg
+
+    def _verify_origin(self, msg: Message) -> bool:
+        """True iff the message's origin signature is valid for the
+        claimed sender (always true on plaintext federations)."""
+        if self._verifier is None:
+            return True
+        if self._verifier.verify(
+            msg.cert, msg.sig, msg.signing_bytes(), msg.sender
+        ):
+            return True
+        log.warning(
+            "node %d dropping %s with unverifiable origin claim sender=%d",
+            self.idx, msg.type.value, msg.sender,
+        )
+        return False
+
     async def broadcast(self, msg: Message, exclude: int | None = None) -> None:
+        self._sign(msg)
         if msg.type in GOSSIPED:
             self.dedup.check_and_add(msg.msg_id)
         await self._forward(msg, exclude)
@@ -430,10 +542,13 @@ class P2PNode:
         try:
             await write_message(
                 peer.writer,
-                Message(MsgType.PARAMS, self.idx, body, payload=blob,
-                        # explicit id: PARAMS is a direct message, but
-                        # proxies relay it and need at-most-once dedup
-                        msg_id=secrets.token_hex(8)),
+                self._sign(
+                    Message(MsgType.PARAMS, self.idx, body, payload=blob,
+                            # explicit id: PARAMS is a direct message,
+                            # but proxies relay it and need at-most-once
+                            # dedup
+                            msg_id=secrets.token_hex(8))
+                ),
             )
         except (ConnectionError, RuntimeError):
             self._drop_conn(peer)
@@ -446,7 +561,14 @@ class P2PNode:
         beats = 0
         while True:
             self.membership.beat(self.idx)
-            await self.broadcast(Message(MsgType.BEAT, self.idx))
+            # the sequence is wall-clock-derived (ms), not a zero-based
+            # counter: it must stay monotonic across a process restart
+            # or a recovered node's fresh beats would read as replays.
+            # Skew doesn't matter — receivers compare per-sender only.
+            await self.broadcast(
+                Message(MsgType.BEAT, self.idx,
+                        {"n": int(time.time() * 1000)})
+            )
             beats += 1
             if beats % 2 == 0:
                 # role refresh every 2nd beat (heartbeater.py:66-78
@@ -725,7 +847,10 @@ class P2PNode:
                 self.leader_history.append(new_leader)
                 await self.broadcast(
                     Message(MsgType.TRANSFER_LEADERSHIP, self.idx,
-                            {"to": new_leader})
+                            # self.round was just incremented: the token
+                            # names the round it takes effect in, and
+                            # receivers reject transfers for past rounds
+                            {"to": new_leader, "round": self.round})
                 )
         await self.broadcast(
             Message(MsgType.MODELS_READY, self.idx, {"round": self.round})

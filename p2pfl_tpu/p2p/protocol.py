@@ -19,6 +19,7 @@ from __future__ import annotations
 import asyncio
 import dataclasses
 import enum
+import hashlib
 import secrets
 import struct
 from collections import OrderedDict
@@ -81,10 +82,34 @@ class Message:
     body: dict[str, Any] = dataclasses.field(default_factory=dict)
     payload: bytes = b""
     msg_id: str = ""
+    # origin authentication (TLS federations only): ECDSA signature
+    # over signing_bytes() + the originator's PEM certificate. Relays
+    # forward both untouched so multi-hop receivers can verify the
+    # ORIGIN, not the relaying connection (see p2p.tls).
+    sig: bytes = b""
+    cert: bytes = b""
 
     def __post_init__(self):
         if not self.msg_id and self.type in GOSSIPED:
             self.msg_id = secrets.token_hex(8)  # :536-548 hash analog
+
+    def signing_bytes(self) -> bytes:
+        """Canonical bytes the origin signature covers. msgpack of a
+        dict is deterministic across pack→unpack→pack (insertion order
+        is preserved), so signer and verifier derive identical bytes.
+        The payload enters as a digest: PARAMS blobs are tens of MB and
+        ECDSA hashes its input anyway."""
+        return msgpack.packb(
+            {
+                "t": self.type.value,
+                "s": self.sender,
+                "b": self.body,
+                "ph": hashlib.sha256(self.payload).digest()
+                if self.payload else b"",
+                "i": self.msg_id,
+            },
+            use_bin_type=True,
+        )
 
     def encode(self) -> bytes:
         frame = msgpack.packb(
@@ -94,6 +119,8 @@ class Message:
                 "b": self.body,
                 "p": self.payload,
                 "i": self.msg_id,
+                "g": self.sig,
+                "c": self.cert,
             },
             use_bin_type=True,
         )
@@ -110,6 +137,8 @@ class Message:
             body=obj.get("b", {}),
             payload=obj.get("p", b""),
             msg_id=obj.get("i", ""),
+            sig=obj.get("g", b""),
+            cert=obj.get("c", b""),
         )
 
 
@@ -136,9 +165,15 @@ class DedupRing:
         self.capacity = capacity
         self._seen: OrderedDict[str, None] = OrderedDict()
 
+    def seen(self, msg_id: str) -> bool:
+        """Peek: has this id been processed? (No registration — lets a
+        receiver authenticate a frame BEFORE marking its id seen, so a
+        forgery can never shadow the genuine message's id.)"""
+        return not msg_id or msg_id in self._seen
+
     def check_and_add(self, msg_id: str) -> bool:
         """True if the id is new (message should be processed)."""
-        if not msg_id or msg_id in self._seen:
+        if self.seen(msg_id):
             return False
         self._seen[msg_id] = None
         while len(self._seen) > self.capacity:

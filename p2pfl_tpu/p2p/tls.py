@@ -11,6 +11,22 @@ cannot join the federation.
 
 Key type is ECDSA P-256 (fast issuance — a 64-node scenario mints its
 certs in well under a second, vs multi-second RSA keygen).
+
+Transport TLS alone authenticates the *connection*, not the *origin* of
+a gossiped message: control messages flood multi-hop, so a relayed
+frame's ``sender`` is legitimately not the connection peer, and a
+malicious-but-valid member could forge another node's STOP or ballot.
+MessageSigner/MessageVerifier close that hole with per-message origin
+signatures: the originator signs the frame's canonical bytes with its
+TLS key and attaches its certificate; receivers chain the cert to the
+pinned scenario CA, require CN == node<sender>, and verify the
+signature. Short-term replay is absorbed by the gossip dedup ring
+(msg_id is inside the signed bytes); a replay after ring eviction can
+only re-deliver a message the origin really sent, and every handler a
+late replay could bite is fenced: ballots and leadership transfers
+carry their round inside the signed bytes and stale rounds are
+rejected, progress snapshots sit behind a monotonic guard, and
+re-evicting a node that already left is idempotent.
 """
 
 from __future__ import annotations
@@ -148,6 +164,96 @@ def make_scenario_credentials(
     ca_cert, ca_key = generate_scenario_ca(directory, name)
     return [issue_node_cert(directory, i, ca_cert, ca_key)
             for i in range(n_nodes)]
+
+
+def _cn_to_idx(cn: str) -> int | None:
+    """The single source of the ``node<idx>`` CN naming rule."""
+    if not cn.startswith("node"):
+        return None
+    try:
+        return int(cn[4:])
+    except ValueError:
+        return None
+
+
+def peer_index(peercert: dict | None) -> int | None:
+    """Node index from a transport peer certificate, as returned by
+    ``ssl``'s ``getpeercert()`` dict form (available because both
+    contexts set CERT_REQUIRED). None if the CN is not ``node<idx>``."""
+    if not peercert:
+        return None
+    for rdn in peercert.get("subject", ()):
+        for key, value in rdn:
+            if key == "commonName":
+                return _cn_to_idx(value)
+    return None
+
+
+class MessageSigner:
+    """Signs self-originated frames with this node's TLS key."""
+
+    def __init__(self, creds: TLSCredentials):
+        self._key = serialization.load_pem_private_key(
+            creds.key.read_bytes(), password=None
+        )
+        self.cert_pem = creds.cert.read_bytes()
+
+    def sign(self, data: bytes) -> bytes:
+        return self._key.sign(data, ec.ECDSA(hashes.SHA256()))
+
+
+class MessageVerifier:
+    """Verifies origin signatures against the pinned scenario CA.
+
+    Certificates arrive attached to the message (a receiver has only
+    its own credentials + the CA, and flooded messages originate from
+    nodes it never handshook with). Verified certs are cached by their
+    PEM bytes so steady-state cost is one ECDSA verify per message.
+    """
+
+    _CACHE_MAX = 4096  # bounded: one entry per distinct member cert
+
+    def __init__(self, ca_cert: str | pathlib.Path):
+        ca = x509.load_pem_x509_certificate(
+            pathlib.Path(ca_cert).read_bytes()
+        )
+        self._ca_key = ca.public_key()
+        self._trusted: dict[bytes, tuple[int, object]] = {}
+
+    def _load(self, cert_pem: bytes) -> tuple[int, object]:
+        cached = self._trusted.get(cert_pem)
+        if cached is not None:
+            return cached
+        cert = x509.load_pem_x509_certificate(cert_pem)
+        # chain to the pinned CA (path length 0: members are leaves)
+        self._ca_key.verify(
+            cert.signature,
+            cert.tbs_certificate_bytes,
+            ec.ECDSA(cert.signature_hash_algorithm),
+        )
+        cn = cert.subject.get_attributes_for_oid(NameOID.COMMON_NAME)[0].value
+        idx = _cn_to_idx(cn)
+        if idx is None:
+            raise ValueError(f"not a member certificate: CN={cn!r}")
+        entry = (idx, cert.public_key())
+        if len(self._trusted) < self._CACHE_MAX:
+            self._trusted[cert_pem] = entry
+        return entry
+
+    def verify(self, cert_pem: bytes, sig: bytes, data: bytes,
+               claimed_idx: int) -> bool:
+        """True iff ``cert_pem`` chains to the CA, its CN names
+        ``claimed_idx``, and ``sig`` covers ``data``."""
+        if not cert_pem or not sig:
+            return False
+        try:
+            idx, public_key = self._load(cert_pem)
+            if idx != claimed_idx:
+                return False
+            public_key.verify(sig, data, ec.ECDSA(hashes.SHA256()))
+            return True
+        except Exception:
+            return False
 
 
 def load_node_credentials(directory: str | pathlib.Path,

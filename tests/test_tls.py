@@ -120,3 +120,141 @@ def test_plaintext_and_foreign_ca_peers_rejected(tmp_path):
             await server.stop()
 
     asyncio.run(main())
+
+
+def test_forged_sender_dropped(tmp_path):
+    """A malicious-but-valid member must not be able to impersonate
+    another node: a STOP (or any control message) claiming a different
+    sender than the signing certificate's CN is dropped, not processed
+    or forwarded (the origin-signature layer in p2p.tls)."""
+
+    async def main():
+        n = 3
+        creds = make_scenario_credentials(tmp_path, n, name="forge")
+        learners = _learners(n)
+        nodes = [
+            P2PNode(i, learners[i], role="aggregator", n_nodes=n,
+                    protocol=_PROTO, gossip_period_s=0.02, tls=creds[i])
+            for i in range(n)
+        ]
+        for node in nodes:
+            await node.start()
+        try:
+            for i in range(n):
+                for j in range(i + 1, n):
+                    await nodes[i].connect_to(nodes[j].host, nodes[j].port)
+            await asyncio.sleep(0.3)
+            assert 2 in nodes[0].membership.get_nodes()
+            evil = nodes[1]
+            # forged STOP "from node 2", signed with node 1's key —
+            # written straight onto node 1's live connection to node 0
+            from p2pfl_tpu.p2p.protocol import Message, MsgType, write_message
+            forged = Message(MsgType.STOP, 2)
+            forged.sig = evil._signer.sign(forged.signing_bytes())
+            forged.cert = evil._signer.cert_pem
+            await write_message(evil.peers[0].writer, forged)
+            # unsigned variant too
+            await write_message(evil.peers[0].writer, Message(MsgType.STOP, 2))
+            await asyncio.sleep(0.5)
+            # node 2 must still be a member everywhere and node 0 must
+            # not have forwarded the forgery
+            assert 2 in nodes[0].membership.get_nodes()
+            assert 2 in nodes[0].peers
+            # a forged leadership transfer is likewise ignored
+            grab = Message(MsgType.TRANSFER_LEADERSHIP, 2, {"to": 1})
+            grab.sig = evil._signer.sign(grab.signing_bytes())
+            grab.cert = evil._signer.cert_pem
+            await write_message(evil.peers[0].writer, grab)
+            await asyncio.sleep(0.3)
+            assert nodes[0].leader is None
+        finally:
+            for node in nodes:
+                await node.stop()
+
+    asyncio.run(main())
+
+
+def test_connect_hello_must_match_cert(tmp_path):
+    """A CONNECT hello claiming an index other than the dialing
+    certificate's CN must be rejected at the handshake."""
+
+    async def main():
+        creds = make_scenario_credentials(tmp_path, 3, name="cn")
+        learners = _learners(2)
+        server = P2PNode(0, learners[0], role="aggregator", n_nodes=3,
+                         protocol=_PROTO, tls=creds[0])
+        await server.start()
+        try:
+            # liar holds node 1's certificate but claims to be node 2
+            liar = P2PNode(2, learners[1], role="aggregator", n_nodes=3,
+                           protocol=_PROTO, tls=creds[1])
+            with pytest.raises((ConnectionError, asyncio.TimeoutError,
+                                asyncio.IncompleteReadError, OSError)):
+                await asyncio.wait_for(
+                    liar.connect_to(server.host, server.port), timeout=5
+                )
+            await asyncio.sleep(0.2)
+            assert not server.peers
+            # honest identity still connects
+            honest = P2PNode(1, learners[1], role="aggregator", n_nodes=3,
+                             protocol=_PROTO, tls=creds[1])
+            await honest.start()
+            await honest.connect_to(server.host, server.port)
+            await asyncio.sleep(0.2)
+            assert 1 in server.peers
+            await honest.stop()
+        finally:
+            await server.stop()
+
+    asyncio.run(main())
+
+
+def test_corrupted_relay_cannot_censor_genuine_flood(tmp_path):
+    """Dedup-poisoning: a malicious relay that forwards a corrupted
+    copy of a mid-flood frame ahead of the honest paths must not cause
+    the genuine frame to be dropped as a duplicate — only VERIFIED
+    frames register in the dedup ring."""
+
+    async def main():
+        n = 3
+        creds = make_scenario_credentials(tmp_path, n, name="poison")
+        learners = _learners(n)
+        nodes = [
+            P2PNode(i, learners[i], role="aggregator", n_nodes=n,
+                    protocol=_PROTO, gossip_period_s=0.02, tls=creds[i])
+            for i in range(n)
+        ]
+        for node in nodes:
+            await node.start()
+        try:
+            for i in range(n):
+                for j in range(i + 1, n):
+                    await nodes[i].connect_to(nodes[j].host, nodes[j].port)
+            await asyncio.sleep(0.2)
+            from p2pfl_tpu.p2p.protocol import Message, MsgType, write_message
+            from p2pfl_tpu.p2p.tls import MessageSigner
+
+            # a genuine signed transfer from node 2 …
+            signer2 = MessageSigner(creds[2])
+            genuine = Message(MsgType.TRANSFER_LEADERSHIP, 2,
+                              {"to": 2, "round": 0})
+            genuine.sig = signer2.sign(genuine.signing_bytes())
+            genuine.cert = signer2.cert_pem
+            # … whose corrupted copy (same msg_id!) node 1 races to
+            # node 0 first
+            corrupted = Message(MsgType.TRANSFER_LEADERSHIP, 2,
+                                {"to": 2, "round": 0}, msg_id=genuine.msg_id)
+            corrupted.sig = b"\x00" * len(genuine.sig)
+            corrupted.cert = genuine.cert
+            await write_message(nodes[1].peers[0].writer, corrupted)
+            await asyncio.sleep(0.2)
+            assert nodes[0].leader is None  # forgery dropped
+            await write_message(nodes[1].peers[0].writer, genuine)
+            await asyncio.sleep(0.3)
+            # the genuine frame must still land despite the shared id
+            assert nodes[0].leader == 2
+        finally:
+            for node in nodes:
+                await node.stop()
+
+    asyncio.run(main())

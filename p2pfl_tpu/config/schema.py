@@ -77,6 +77,10 @@ class ProtocolConfig:
     # sender gives up (the reference counts ticks at 1 Hz — same unit)
     gossip_exit_on_equal_rounds: int = 20
     train_set_size: int = 10  # TRAIN_SET_SIZE; <=0 disables the cap
+    # gossip/poll tick on the socket path — the GOSSIP_MODELS_FREC
+    # analog (participant.json.example:81; the reference paces its
+    # gossiper thread by frequency, here it is the sleep between ticks)
+    gossip_period_s: float = 0.05
 
 
 @dataclasses.dataclass

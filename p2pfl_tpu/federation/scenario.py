@@ -39,7 +39,7 @@ from p2pfl_tpu.federation.checkpoint import (
 from p2pfl_tpu.federation.events import Events, Observable
 from p2pfl_tpu.federation.membership import Membership
 from p2pfl_tpu.learning.learner import make_step_fns
-from p2pfl_tpu.models import get_model
+from p2pfl_tpu.models.base import build_model
 from p2pfl_tpu.parallel.federated import (
     FederatedState,
     build_eval_fn,
@@ -77,7 +77,7 @@ class Scenario(Observable):
         self.config = config
         n = config.n_nodes
         self.dataset = dataset or FederatedDataset.make(config.data, n)
-        self.model = get_model(config.model.model, **config.model.kwargs)
+        self.model = build_model(config.model)
         self.fns = make_step_fns(
             self.model,
             objective=config.model.objective,

@@ -44,3 +44,17 @@ def get_model(name: str, **kwargs) -> nn.Module:
 
 def list_models() -> list[str]:
     return sorted(_REGISTRY)
+
+
+def build_model(model_cfg) -> nn.Module:
+    """Construct a model from a ``ModelConfig``, honoring its dtype
+    knobs: ``compute_dtype`` feeds the modules' ``dtype`` (bfloat16 by
+    default → MXU-native matmuls) and ``param_dtype`` their parameter
+    storage. Explicit ``kwargs`` entries win so a scenario can still
+    override per-model."""
+    import jax.numpy as jnp
+
+    kwargs = dict(model_cfg.kwargs)
+    kwargs.setdefault("dtype", jnp.dtype(model_cfg.compute_dtype))
+    kwargs.setdefault("param_dtype", jnp.dtype(model_cfg.param_dtype))
+    return get_model(model_cfg.model, **kwargs)

@@ -47,15 +47,21 @@ class OneClassSVM(nn.Module):
     """Linear one-class SVM head: returns decision scores ``w·x − ρ``."""
 
     in_features: int = 17
+    # compute dtype defaults to f32 (a 17-wide dot has no MXU win and
+    # the margin comparison is precision-sensitive); accepted so the
+    # ModelConfig.compute_dtype knob applies uniformly
+    dtype: jnp.dtype = jnp.float32
     param_dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, x):
-        x = x.reshape((x.shape[0], -1)).astype(jnp.float32)
+        x = x.reshape((x.shape[0], -1)).astype(self.dtype)
         w = self.param("w", nn.initializers.zeros, (self.in_features,),
                        self.param_dtype)
         rho = self.param("rho", nn.initializers.zeros, (), self.param_dtype)
-        return x @ w - rho
+        return (x @ w.astype(self.dtype) - rho.astype(self.dtype)).astype(
+            jnp.float32
+        )
 
 
 @register_model("syscall-autoencoder", "syscallmodelautoencoder")

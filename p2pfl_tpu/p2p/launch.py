@@ -29,7 +29,7 @@ from p2pfl_tpu.config.schema import ScenarioConfig
 from p2pfl_tpu.core.aggregators import get_aggregator
 from p2pfl_tpu.datasets import FederatedDataset
 from p2pfl_tpu.learning import JaxLearner
-from p2pfl_tpu.models import get_model
+from p2pfl_tpu.models.base import build_model
 from p2pfl_tpu.p2p.node import P2PNode
 from p2pfl_tpu.topology.topology import generate_topology
 
@@ -66,7 +66,7 @@ async def _run_node(cfg: ScenarioConfig, idx: int, ports: list[int],
         tls = load_node_credentials(tls_dir, idx)
     data = FederatedDataset.make(cfg.data, n)  # deterministic: same shards
     learner = JaxLearner(
-        model=get_model(cfg.model.model, **cfg.model.kwargs),
+        model=build_model(cfg.model),
         data=data.nodes[idx],
         objective=cfg.model.objective,
         optimizer=cfg.training.optimizer,

@@ -87,7 +87,7 @@ class P2PNode:
         aggregator: Aggregator | None = None,
         protocol: ProtocolConfig | None = None,
         start_learning: bool = False,
-        gossip_period_s: float = 0.05,
+        gossip_period_s: float | None = None,
         federation: str = "DFL",
         seed: int = 0,
         tls=None,
@@ -102,7 +102,12 @@ class P2PNode:
         self.n_nodes = n_nodes
         self.protocol = protocol or ProtocolConfig()
         self.start_learning_flag = start_learning
-        self.gossip_period_s = gossip_period_s
+        # explicit argument wins; otherwise the ProtocolConfig knob
+        # (GOSSIP_MODELS_FREC analog) paces gossip/poll ticks
+        self.gossip_period_s = (
+            gossip_period_s if gossip_period_s is not None
+            else self.protocol.gossip_period_s
+        )
         self.federation = federation
         # mutual TLS (p2pfl_tpu.p2p.tls.TLSCredentials) — replaces the
         # reference's RSA/AES-ECB handshake (encrypter.py:48-193).

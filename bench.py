@@ -1,31 +1,51 @@
-"""Benchmark: the north-star workload + MFU + rounds-to-accuracy.
+"""Benchmark: the north-star workload + MFU + rounds-to-accuracy,
+plus the two remaining BASELINE.json configs (CIFAR-16-Dirichlet and
+ViT-Tiny-32-Krum) run end-to-end.
 
 Primary metric (BASELINE.json north star): steady-state wall-clock per
 federated round for a **64-node FEMNIST-CNN** federation (ring
-topology, FedAvg, 1 local epoch over 750 samples/node, batch 64 —
-batch/lr swept: {32,64,128}x{0.05,0.08,0.12}; 64@0.05 dominates both
-rounds-to-80% and wall-clock) on the available TPU device(s) — one
-vmapped SPMD program; on a pod slice the same program shards 1
-node/chip.
+topology, FedAvg, 1 local epoch, batch 150, lr 0.05 — swept
+{64,128,150,250}x{0.05,0.065,0.08,0.12,0.15}: larger batches cut the
+round's HBM-bound weight-state traffic (fewer SGD steps over the same
+6.4M params/node — see docs/perf.md roofline), and 150@0.05 gives the
+best seconds-to-80% while 64@0.05 still wins rounds-to-80%) on the
+available TPU device(s) — one vmapped SPMD program; on a pod slice the
+same program shards 1 node/chip.
 
-Baseline: the reference cannot complete a federated round faster than
-its built-in pacing: WAIT_HEARTBEATS_CONVERGENCE = 10 s of mandatory
-sleep per learning start (participant.json.example:76, node.py:302-304)
-plus model gossip at GOSSIP_MODELS_FREC = 1 Hz with fan-out 2
-(participant.json.example:81-82) needing >= ceil(log2(n)) + 1 ticks for
-diffusion, plus per-round aggregation waits — a floor of ~15 s/round
-before any compute, independent of hardware. ``vs_baseline`` is the
-speedup (baseline / measured).
+Timing method: 10 rounds chained per host sync. The axon tunnel to the
+bench chip costs ~0.11 s per dispatch+fetch (measured: a null program
+takes that long), so per-round syncing would measure the tunnel, not
+the device; chained dispatches pipeline on the device queue. On real
+local hardware the two methods agree.
+
+``vs_derived_floor``: the reference cannot complete a federated round
+faster than its built-in pacing: WAIT_HEARTBEATS_CONVERGENCE = 10 s of
+mandatory sleep per learning start (participant.json.example:76,
+node.py:302-304) plus model gossip at GOSSIP_MODELS_FREC = 1 Hz with
+fan-out 2 (participant.json.example:81-82) needing >= ceil(log2(n))+1
+ticks for diffusion, plus per-round aggregation waits — a floor of
+~15 s/round before any compute, independent of hardware. The key is a
+DERIVED floor (the reference publishes no numbers — BASELINE.md), not
+a measured run; the ratio is floor / measured.
 
 Extra keys in the same JSON line:
 - ``mfu`` / ``achieved_tflops``: hardware utilization of the round
   program (XLA cost-analysis FLOPs over measured wall-clock, against
   the chip's bf16 peak);
 - ``rounds_to_80pct`` / ``seconds_to_80pct``: rounds and wall-clock for
-  the 64-node federation to reach 80% mean test accuracy (the north
-  star's accuracy target; surrogate FEMNIST when real files absent);
-- ``round_s_8node``: the round-1 continuity metric (same 8-node config
-  as BENCH_r01).
+  the 64-node federation to reach 80% mean test accuracy, measured by
+  a single-dispatch trajectory program with an in-round eval on the
+  same 2000-sample test subset BENCH_r01/r02 thresholded on
+  (surrogate FEMNIST when real files absent);
+- ``round_s_8node``: round-1/2 continuity metric — SAME config (batch
+  64, f32 exchange) and SAME per-round-sync timing as BENCH_r01/r02;
+- ``cifar16_*``: BASELINE.json configs[2] — CIFAR10 ResNet9 (the
+  reference's CIFAR CNN, cifar10/models/resnet.py), 16 nodes, random
+  topology, Dirichlet(0.5) non-IID shards, FedAvg;
+- ``vit32_*``: BASELINE.json configs[4] (stretch) — ViT-Tiny, 32
+  nodes, Krum aggregator, Pallas flash attention (use_flash=True);
+- ``cpu8_ring_*``: both collective schedules (dense all-gather einsum
+  vs O(degree) ppermute) on an 8-device virtual CPU mesh.
 """
 
 from __future__ import annotations
@@ -33,7 +53,7 @@ from __future__ import annotations
 import json
 import time
 
-BASELINE_ROUND_S = 15.0  # reference pacing floor, see module docstring
+BASELINE_ROUND_S = 15.0  # derived reference pacing floor, see docstring
 
 # bf16 peak FLOP/s per chip, by device_kind substring
 _PEAKS = {
@@ -56,8 +76,15 @@ def _peak_flops(device) -> float | None:
     return None
 
 
-def _build(n: int, samples_per_node: int = 750, batch_size: int = 64,
-           seed: int = 0, with_eval: bool = False):
+def _build(n: int, *, dataset="femnist", model="femnist-cnn",
+           topology="ring", aggregator=None, partition="iid",
+           samples_per_node=750, batch_size=150, learning_rate=0.05,
+           optimizer="sgd", exchange_dtype="bf16", seed=0,
+           model_kwargs=None):
+    """Assemble one federated configuration into compiled programs.
+
+    Returns a dict of everything the timing/trajectory helpers need.
+    """
     import jax.numpy as jnp
 
     from p2pfl_tpu.config.schema import DataConfig
@@ -65,7 +92,6 @@ def _build(n: int, samples_per_node: int = 750, batch_size: int = 64,
     from p2pfl_tpu.learning.learner import make_step_fns
     from p2pfl_tpu.models import get_model
     from p2pfl_tpu.parallel.federated import (
-        build_eval_fn,
         build_round_fn,
         init_federation,
         make_round_plan,
@@ -74,77 +100,194 @@ def _build(n: int, samples_per_node: int = 750, batch_size: int = 64,
     from p2pfl_tpu.topology.topology import generate_topology
 
     ds = FederatedDataset.make(
-        DataConfig(dataset="femnist", samples_per_node=samples_per_node,
-                   batch_size=batch_size),
+        DataConfig(dataset=dataset, samples_per_node=samples_per_node,
+                   batch_size=batch_size, partition=partition,
+                   dirichlet_alpha=0.5, seed=seed),
         n,
     )
     x, y, smask, nsamp = ds.stacked()
-    fns = make_step_fns(get_model("femnist-cnn"), learning_rate=0.05,
+    fns = make_step_fns(get_model(model, **(model_kwargs or {})),
+                        optimizer=optimizer, learning_rate=learning_rate,
                         batch_size=batch_size)
-    topo = generate_topology("ring", n)
+    topo_kw = {"seed": seed} if topology in ("ring", "random") else {}
+    topo = generate_topology(topology, n, **topo_kw)
     plan = make_round_plan(topo, ["aggregator"] * n, "DFL")
     tr = MeshTransport(n)
     fed = tr.put_stacked(init_federation(fns, jnp.asarray(x[0, :1]), n,
                                          seed=seed))
-    args = [
+    fargs = tuple(
         tr.put_stacked(jnp.asarray(a))
         for a in (x, y, smask, nsamp, plan.mix, plan.adopt, plan.trains)
-    ]
-    round_fn = tr.compile_round(build_round_fn(fns, epochs=1))
-    # eval setup only where used (the accuracy federation) — it costs a
-    # compile plus a replicated test-set transfer per build
-    eval_fn = x_test = y_test = None
-    if with_eval:
-        eval_fn = tr.compile_eval(build_eval_fn(fns))
-        x_test = tr.put_replicated(jnp.asarray(ds.x_test[:2000]))
-        y_test = tr.put_replicated(jnp.asarray(ds.y_test[:2000]))
+    )
+    ex_dt = jnp.bfloat16 if exchange_dtype == "bf16" else None
+    round_fn = tr.compile_round(
+        build_round_fn(fns, aggregator=aggregator, epochs=1,
+                       exchange_dtype=ex_dt)
+    )
+    shard = int(x.shape[1])
+    bsz = min(batch_size, shard)
 
     def reset(new_seed: int):
         """Fresh federation state for the SAME compiled programs —
-        lets a timed run reuse a warmed jit cache (jit caches key on
-        the function object, so rebuilding round_fn would recompile)."""
+        jit caches key on the function object, so rebuilding round_fn
+        would recompile."""
         return tr.put_stacked(
             init_federation(fns, jnp.asarray(x[0, :1]), n, seed=new_seed)
         )
 
-    return fed, args, round_fn, eval_fn, x_test, y_test, int(x.shape[1]), reset
+    return {
+        "n": n, "ds": ds, "fns": fns, "tr": tr, "fed": fed,
+        "fargs": fargs, "round_fn": round_fn, "reset": reset,
+        "aggregator": aggregator,
+        "shard": shard, "used": (shard // bsz) * bsz,
+        "config": dict(dataset=dataset, model=model, topology=topology,
+                       partition=partition, batch_size=batch_size,
+                       learning_rate=learning_rate, optimizer=optimizer,
+                       samples_per_node=samples_per_node,
+                       exchange_dtype=exchange_dtype,
+                       model_kwargs=model_kwargs or {}),
+    }
 
 
-def _time_rounds(fed, args, round_fn, reps: int = 5):
+def _time_chained(run, k: int = 10, reps: int = 3) -> float:
+    """Median steady-state s/round over ``reps`` batches of ``k``
+    chained dispatches with one device->host sync each (see module
+    docstring for why per-round syncing is wrong on this tunnel)."""
     import jax.numpy as jnp
     import numpy as np
 
-    # warmup (compile) + steady state; a device->host scalar fetch per
-    # round forces real synchronization (block_until_ready on donated
-    # buffers can return early on the experimental axon backend)
-    fed, m = round_fn(fed, *args)
+    fed, fargs, round_fn = run["fed"], run["fargs"], run["round_fn"]
+    fed, m = round_fn(fed, *fargs)  # compile
     float(jnp.sum(m["train_loss"]))
     times = []
     for _ in range(reps):
         t0 = time.monotonic()
-        fed, m = round_fn(fed, *args)
+        for _ in range(k):
+            fed, m = round_fn(fed, *fargs)
+        float(jnp.sum(m["train_loss"]))
+        times.append((time.monotonic() - t0) / k)
+    run["fed"] = fed
+    return float(np.median(times))
+
+
+def _time_rounds_synced(run, reps: int = 5) -> float:
+    """The BENCH_r01/r02 timing method (one sync per round) — kept
+    verbatim for the 8-node continuity metric."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    fed, fargs, round_fn = run["fed"], run["fargs"], run["round_fn"]
+    fed, m = round_fn(fed, *fargs)
+    float(jnp.sum(m["train_loss"]))
+    times = []
+    for _ in range(reps):
+        t0 = time.monotonic()
+        fed, m = round_fn(fed, *fargs)
         float(jnp.sum(m["train_loss"]))
         times.append(time.monotonic() - t0)
-    return fed, float(np.median(times))
+    run["fed"] = fed
+    return float(np.median(times))
 
 
-def _round_flops(round_fn, fed, args) -> float | None:
+def _round_flops(round_fn, fed, fargs) -> float | None:
     try:
-        cost = round_fn.lower(fed, *args).compile().cost_analysis()
+        cost = round_fn.lower(fed, *fargs).compile().cost_analysis()
         flops = cost.get("flops") if isinstance(cost, dict) else None
         return float(flops) if flops else None
     except Exception:
         return None
 
 
-def _probe_flops(n: int, shard: int) -> float | None:
+def _probe_flops(run) -> float | None:
     """True per-round FLOPs: XLA's cost analysis counts a ``scan``
     body ONCE regardless of trip count, so the batched round program
     under-reports by ~#steps. Probe with a mathematically equivalent
-    single-step program (batch = whole shard -> scan trip 1): same
-    matmul/conv FLOPs over the same samples, accurately counted."""
-    fed, args, round_fn, *_rest = _build(n, batch_size=shard)
-    return _round_flops(round_fn, fed, args)
+    single-step program: batch = the samples the real program actually
+    uses per epoch ((shard // batch) * batch -> scan trip 1), same
+    matmul/conv FLOPs over the same sample count, accurately counted."""
+    cfg = run["config"]
+    probe = _build(run["n"], dataset=cfg["dataset"], model=cfg["model"],
+                   topology=cfg["topology"], partition=cfg["partition"],
+                   aggregator=run["aggregator"],
+                   samples_per_node=cfg["samples_per_node"],
+                   batch_size=run["used"],
+                   learning_rate=cfg["learning_rate"],
+                   optimizer=cfg["optimizer"],
+                   exchange_dtype=cfg["exchange_dtype"],
+                   model_kwargs=cfg["model_kwargs"])
+    return _round_flops(probe["round_fn"], probe["fed"], probe["fargs"])
+
+
+def _make_trajectory(run, max_rounds: int = 30, eval_samples: int = 2000):
+    """One-dispatch accuracy trajectory: ``traj(fed, length)`` runs
+    ``length`` rounds with an in-round mean-test-accuracy eval on a
+    replicated ``eval_samples`` subset (2000 — the same threshold
+    sample size BENCH_r01/r02 used, keeping rounds_to_80pct comparable
+    across rounds), returning (fed, accs[max]). ``length`` is a traced
+    fori_loop bound -> one compile serves both the 30-round search and
+    the timed rounds-to-80 re-run."""
+    import jax
+    import jax.numpy as jnp
+
+    fns, tr, ds = run["fns"], run["tr"], run["ds"]
+    fargs = run["fargs"]
+    xt = tr.put_replicated(jnp.asarray(ds.x_test[:eval_samples]))
+    yt = tr.put_replicated(jnp.asarray(ds.y_test[:eval_samples]))
+    # a fresh (undonated) round fn for the loop body — the donated
+    # jitted one can't be re-invoked on its own output inside a trace
+    from p2pfl_tpu.core.aggregators import FedAvg
+    from p2pfl_tpu.parallel.federated import build_eval_fn, build_round_fn
+    cfg = run["config"]
+    ex_dt = jnp.bfloat16 if cfg["exchange_dtype"] == "bf16" else None
+    body_round = build_round_fn(fns, aggregator=run.get("aggregator") or FedAvg(),
+                                epochs=1, exchange_dtype=ex_dt)
+    body_eval = build_eval_fn(fns)
+
+    @jax.jit
+    def traj(fed, length):
+        def body(r, carry):
+            fed, accs = carry
+            fed, _ = body_round(fed, *fargs)
+            ev = body_eval(fed, xt, yt)
+            return fed, accs.at[r].set(jnp.mean(ev["accuracy"]))
+
+        accs = jnp.zeros((max_rounds,), jnp.float32)
+        return jax.lax.fori_loop(0, length, body, (fed, accs))
+
+    return traj, jax.jit(body_eval), xt, yt
+
+
+def _accuracy_run(run, target: float = 0.80, max_rounds: int = 30,
+                  measure_seconds: bool = True):
+    """rounds/seconds-to-target + final accuracy on the FULL test set.
+
+    ``measure_seconds=False`` skips the timed re-run (a fresh
+    federation re-trained for exactly ``r80`` rounds) for callers that
+    only report the round count — it costs real device minutes."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    traj, eval_fn, _, _ = _make_trajectory(run, max_rounds)
+    fed0 = run["reset"](1)
+    fed_end, accs = traj(fed0, max_rounds)  # includes compile
+    accs = np.asarray(accs)
+    hit = accs >= target
+    r80 = int(np.argmax(hit)) + 1 if hit.any() else None
+
+    seconds = None
+    if r80 is not None and measure_seconds:
+        fed1 = run["reset"](1)
+        t0 = time.monotonic()
+        _, accs2 = traj(fed1, r80)
+        float(jnp.sum(accs2))
+        seconds = round(time.monotonic() - t0, 3)
+
+    ds, tr = run["ds"], run["tr"]
+    xt_full = tr.put_replicated(jnp.asarray(ds.x_test))
+    yt_full = tr.put_replicated(jnp.asarray(ds.y_test))
+    final = float(np.mean(np.asarray(
+        eval_fn(fed_end, xt_full, yt_full)["accuracy"])))
+    return r80, seconds, final, accs
 
 
 def _sparse_vs_dense_cpu() -> dict:
@@ -216,48 +359,82 @@ print("BENCH_CPU8 " + json.dumps(out))
     return {"cpu8_ring_dense_round_s": None, "cpu8_ring_sparse_round_s": None}
 
 
+def _cifar16() -> dict:
+    """BASELINE.json configs[2]: CIFAR10 ResNet9, 16 nodes, random
+    topology, Dirichlet(0.5) shards, FedAvg. Reports steady-state
+    round time, accuracy after 40 rounds, and data provenance."""
+    try:
+        run = _build(16, dataset="cifar10", model="resnet9",
+                     topology="random", partition="dirichlet",
+                     samples_per_node=1024, batch_size=128,
+                     learning_rate=0.1, seed=3)
+        round_s = _time_chained(run, k=5, reps=3)
+        r80, _, final, accs = _accuracy_run(run, target=0.80, max_rounds=40,
+                                            measure_seconds=False)
+        return {
+            "cifar16_dirichlet_round_s": round(round_s, 4),
+            "cifar16_dirichlet_rounds_to_80pct": r80,
+            "cifar16_dirichlet_acc_40r": round(float(accs[39]), 4),
+            "cifar16_dirichlet_final_acc": round(final, 4),
+            "cifar16_synthetic_data": run["ds"].synthetic,
+        }
+    except Exception as e:
+        import sys
+        print(f"cifar16 config failed: {e!r}", file=sys.stderr)
+        return {"cifar16_dirichlet_round_s": None}
+
+
+def _vit32() -> dict:
+    """BASELINE.json configs[4] (stretch): ViT-Tiny, 32 nodes, Krum
+    aggregator, Pallas flash attention — the first on-TPU federation
+    exercising ops.flash under the robust-aggregation path."""
+    try:
+        from p2pfl_tpu.core.aggregators import Krum
+
+        run = _build(32, dataset="cifar10", model="vit-tiny",
+                     topology="fully", aggregator=Krum(f=1, m=3),
+                     partition="iid", samples_per_node=512,
+                     batch_size=115, learning_rate=1e-3,
+                     optimizer="adam", seed=4,
+                     model_kwargs={"use_flash": True, "remat": True,
+                                   "scan_layers": True})
+        round_s = _time_chained(run, k=5, reps=3)
+        _, _, final, accs = _accuracy_run(run, target=0.80, max_rounds=20,
+                                          measure_seconds=False)
+        return {
+            "vit32_krum_flash_round_s": round(round_s, 4),
+            "vit32_krum_flash_acc_20r": round(float(accs[19]), 4),
+            "vit32_krum_flash_final_acc": round(final, 4),
+            "vit32_synthetic_data": run["ds"].synthetic,
+        }
+    except Exception as e:
+        import sys
+        print(f"vit32 config failed: {e!r}", file=sys.stderr)
+        return {"vit32_krum_flash_round_s": None}
+
+
 def main() -> None:
     import jax
-    import numpy as np
 
-    n = 64
-    fed, args, round_fn, _, _, _, shard, _ = _build(n)
-    direct = _round_flops(round_fn, fed, args)
-    probe = _probe_flops(n, shard)
+    # ---- headline: 64-node FEMNIST-CNN ring -------------------------
+    run = _build(64)
+    round_s = _time_chained(run)
+    direct = _round_flops(run["round_fn"], run["fed"], run["fargs"])
+    probe = _probe_flops(run)
     flops = max(f for f in (direct, probe) if f) if (direct or probe) else None
-    fed, round_s = _time_rounds(fed, args, round_fn)
 
     peak = _peak_flops(jax.devices()[0])
     achieved = flops / round_s if flops else None
     mfu = achieved / (peak * len(jax.devices())) if achieved and peak else None
 
-    # ---- rounds / seconds to the 80% north-star accuracy -------------
-    # steady-state semantics like the round timer: warm THESE compiled
-    # programs (one round + one eval), then reset the federation state
-    # and time the fresh run through the warmed jit cache
-    fed2, args2, round_fn2, eval_fn2, xt, yt, _, reset = _build(
-        n, seed=2, with_eval=True
-    )
-    fed2, _ = round_fn2(fed2, *args2)  # donates fed2; reset() replaces it
-    float(np.mean(np.asarray(eval_fn2(fed2, xt, yt)["accuracy"])))
-    fed2 = reset(1)
-    rounds_to_80 = None
-    t0 = time.monotonic()
-    seconds_to_80 = None
-    for r in range(1, 31):
-        fed2, _ = round_fn2(fed2, *args2)
-        acc = float(np.mean(np.asarray(eval_fn2(fed2, xt, yt)["accuracy"])))
-        if acc >= 0.80:
-            rounds_to_80 = r
-            seconds_to_80 = round(time.monotonic() - t0, 3)
-            break
-    final_acc = acc
+    rounds_to_80, seconds_to_80, final_acc, _ = _accuracy_run(run)
 
-    # ---- round-1 continuity metric (8-node config) --------------------
-    fed8, args8, round_fn8, *_rest8 = _build(8)
-    _, round_s_8 = _time_rounds(fed8, args8, round_fn8)
+    # ---- round-1/2 continuity metric (8-node, batch 64, f32) --------
+    run8 = _build(8, batch_size=64, exchange_dtype="f32")
+    round_s_8 = _time_rounds_synced(run8)
 
-    # ---- both collective schedules on the 8-device CPU mesh -----------
+    cifar = _cifar16()
+    vit = _vit32()
     cpu8 = _sparse_vs_dense_cpu()
 
     print(
@@ -266,7 +443,10 @@ def main() -> None:
                 "metric": "femnist_cnn_64node_ring_round_wall_clock",
                 "value": round(round_s, 4),
                 "unit": "s/round",
-                "vs_baseline": round(BASELINE_ROUND_S / round_s, 2),
+                "vs_derived_floor": round(BASELINE_ROUND_S / round_s, 2),
+                "baseline_note": "reference publishes no numbers; floor "
+                                 "derived from its mandatory sleeps+gossip "
+                                 "pacing (BASELINE.md)",
                 "achieved_tflops": (
                     round(achieved / 1e12, 3) if achieved else None
                 ),
@@ -277,6 +457,8 @@ def main() -> None:
                 "seconds_to_80pct": seconds_to_80,
                 "final_accuracy": round(final_acc, 4),
                 "round_s_8node": round(round_s_8, 4),
+                **cifar,
+                **vit,
                 **cpu8,
             }
         )

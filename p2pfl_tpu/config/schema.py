@@ -41,8 +41,12 @@ class ModelConfig:
 
     model: str = "mlp"
     objective: str = "classification"  # classification | autoencoder | ocsvm
-    param_dtype: str = "float32"
-    compute_dtype: str = "bfloat16"  # MXU-native
+    # None = keep each model's own default (bf16 compute / f32 params
+    # for most; the one-class SVM deliberately computes in f32 — its
+    # margin comparison is precision-sensitive and a 17-wide dot has
+    # no MXU win). Set explicitly to override per-scenario.
+    param_dtype: str | None = None
+    compute_dtype: str | None = None
     kwargs: dict[str, Any] = dataclasses.field(default_factory=dict)
 
 

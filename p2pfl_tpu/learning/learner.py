@@ -71,7 +71,8 @@ class StepFns:
     """The pure-function core of a learner — safe to vmap/shard_map."""
 
     init: Callable  # (rng, sample_x) -> TrainState
-    train_epochs: Callable  # (state, x, y, mask, epochs) -> (state, metrics)
+    train_epochs: Callable  # (state, x, y, mask, epochs, gate=None)
+    # -> (state, metrics); gate: per-node 1.0/0.0 update scale
     evaluate: Callable  # (params, x, y, mask) -> metrics dict
     tx: Any
 
@@ -97,7 +98,14 @@ def make_step_fns(
     (lightninglearner.py:167-193).
     """
     loss_fn = get_objective(objective)
-    tx = make_optimizer(optimizer, learning_rate, momentum, weight_decay)
+    # decay applied to the explicit gradient below, NOT via an
+    # add_decayed_weights chain: the chain turns zero (gated-off)
+    # grads back into wd*params inside tx.update, silently feeding
+    # momentum on frozen nodes. adamw keeps its decoupled decay — its
+    # decay rides the updates, which the gate also zeroes.
+    explicit_decay = weight_decay if optimizer.lower() != "adamw" else 0.0
+    tx = make_optimizer(optimizer, learning_rate, momentum,
+                        0.0 if explicit_decay else weight_decay)
 
     def init(rng, sample_x) -> TrainState:
         params = model.init(rng, sample_x)
@@ -116,7 +124,31 @@ def make_step_fns(
             return loss_fn(out, by, bmask) + ocsvm_penalty(params)
         return loss_fn(out, by, bmask)
 
-    def train_one_epoch(state: TrainState, xym):
+    def _shuffle(x, perm):
+        """Per-epoch reshuffle of the shard. TPU row-gathers of small
+        rows serialize badly (~27 ms/epoch for the 64-node north-star
+        workload); a one-hot matmul does the same permutation on the
+        MXU at memory speed (~4 ms measured). Exact for float inputs:
+        each output row is 1.0 * one source row, and f32*1.0 followed
+        by a sum of zeros is bit-exact. Integer/bool inputs (labels,
+        masks, token ids) keep the gather — their rows are tiny."""
+        # one-hot is O(s^2) in shard size — a federated shard (<=4k
+        # rows) wins big, but a single-node learner training a whole
+        # 20k-row dataset would materialize a [20k,20k] matrix; the
+        # gather is the right tool there
+        if (not jnp.issubdtype(x.dtype, jnp.floating) or x.ndim < 2
+                or x.shape[0] > 4096):
+            return x[perm]
+        oh = jax.nn.one_hot(perm, x.shape[0], dtype=x.dtype)
+        flat = x.reshape(x.shape[0], -1)
+        # HIGHEST precision: TPU matmuls default to bf16-truncated
+        # inputs, which would silently round every pixel each epoch;
+        # full-precision passes keep the claim above true at a cost
+        # that is still far below the row-gather being replaced
+        out = jax.lax.dot(oh, flat, precision=jax.lax.Precision.HIGHEST)
+        return out.reshape((perm.shape[0],) + x.shape[1:])
+
+    def train_one_epoch(state: TrainState, xym, gate):
         x, y, mask = xym
         s = x.shape[0]
         bsz = min(batch_size, s)  # shards smaller than a batch still train
@@ -124,7 +156,7 @@ def make_step_fns(
         used = steps * bsz
         rng, perm_rng = jax.random.split(state.rng)
         perm = jax.random.permutation(perm_rng, s)[:used]
-        bx = x[perm].reshape((steps, bsz) + x.shape[1:])
+        bx = _shuffle(x, perm).reshape((steps, bsz) + x.shape[1:])
         by = y[perm].reshape(steps, bsz)
         bm = mask[perm].reshape(steps, bsz)
 
@@ -132,7 +164,24 @@ def make_step_fns(
             st, loss_sum = carry
             xb, yb, mb = batch
             loss, grads = jax.value_and_grad(batch_loss)(st.params, xb, yb, mb)
+            if explicit_decay:
+                grads = jax.tree.map(
+                    lambda g, p: g + explicit_decay * p, grads, st.params)
+            if gate is not None:
+                # zero grads AND updates instead of where-selecting whole
+                # trees afterward: params stay bit-exact for gated-off
+                # nodes (x + 0 == x) without an extra full-tree memory
+                # pass, and no real gradient leaks into momentum.
+                # ``where``, not ``* gate``: 0.0 * NaN is NaN, and a
+                # gated-off node whose shard produces a non-finite grad
+                # must stay frozen, not poisoned
+                on = gate > 0
+                grads = jax.tree.map(
+                    lambda g: jnp.where(on, g, jnp.zeros_like(g)), grads)
             updates, opt_state = tx.update(grads, st.opt_state, st.params)
+            if gate is not None:
+                updates = jax.tree.map(
+                    lambda u: jnp.where(on, u, jnp.zeros_like(u)), updates)
             params = optax.apply_updates(st.params, updates)
             st = st.replace(params=params, opt_state=opt_state,
                             step=st.step + 1)
@@ -142,9 +191,16 @@ def make_step_fns(
         state = state.replace(rng=rng)
         return state, loss_sum / steps
 
-    def train_epochs(state: TrainState, x, y, mask, epochs: int):
+    def train_epochs(state: TrainState, x, y, mask, epochs: int, gate=None):
+        """``gate`` (optional f32 scalar, 1.0/0.0) scales every SGD
+        update — the federated layer's trains∧alive selection folded
+        into the step so frozen nodes cost no extra tree traffic.
+        Gated-off nodes keep params exactly; their momentum decays,
+        matching the reference's per-round optimizer reset
+        (lightninglearner.py:167-193 builds a fresh Trainer per fit)."""
+
         def body(st, _):
-            st, loss = train_one_epoch(st, (x, y, mask))
+            st, loss = train_one_epoch(st, (x, y, mask), gate)
             return st, loss
 
         state, losses = jax.lax.scan(body, state, None, length=epochs)

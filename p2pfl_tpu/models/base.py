@@ -48,13 +48,16 @@ def list_models() -> list[str]:
 
 def build_model(model_cfg) -> nn.Module:
     """Construct a model from a ``ModelConfig``, honoring its dtype
-    knobs: ``compute_dtype`` feeds the modules' ``dtype`` (bfloat16 by
-    default → MXU-native matmuls) and ``param_dtype`` their parameter
-    storage. Explicit ``kwargs`` entries win so a scenario can still
-    override per-model."""
+    knobs: ``compute_dtype`` feeds the modules' ``dtype`` and
+    ``param_dtype`` their parameter storage. ``None`` (the default)
+    keeps each model's own choice — important for the one-class SVM,
+    which computes in f32 on purpose. Explicit ``kwargs`` entries win
+    so a scenario can still override per-model."""
     import jax.numpy as jnp
 
     kwargs = dict(model_cfg.kwargs)
-    kwargs.setdefault("dtype", jnp.dtype(model_cfg.compute_dtype))
-    kwargs.setdefault("param_dtype", jnp.dtype(model_cfg.param_dtype))
+    if model_cfg.compute_dtype is not None:
+        kwargs.setdefault("dtype", jnp.dtype(model_cfg.compute_dtype))
+    if model_cfg.param_dtype is not None:
+        kwargs.setdefault("param_dtype", jnp.dtype(model_cfg.param_dtype))
     return get_model(model_cfg.model, **kwargs)

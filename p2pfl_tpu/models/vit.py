@@ -10,6 +10,8 @@ sequence shards with ``ppermute`` K/V rotation over ICI.
 
 from __future__ import annotations
 
+from typing import Any
+
 import flax.linen as nn
 import jax.numpy as jnp
 
@@ -63,6 +65,19 @@ class TransformerBlock(nn.Module):
         return x + y
 
 
+class _BlockStep(nn.Module):
+    """``nn.scan`` adapter: ``(carry, _) -> (carry, None)`` around one
+    (optionally rematted) TransformerBlock."""
+
+    remat: bool = False
+    block_kw: Any = None
+
+    @nn.compact
+    def __call__(self, x, _):
+        cls = nn.remat(TransformerBlock) if self.remat else TransformerBlock
+        return cls(**(self.block_kw or {}))(x), None
+
+
 class ViT(nn.Module):
     """ViT-Tiny by default: patch 4 (CIFAR-scale), dim 192, 12 layers."""
 
@@ -75,6 +90,12 @@ class ViT(nn.Module):
     param_dtype: jnp.dtype = jnp.float32
     seq_axis: str | None = None
     use_flash: bool = False
+    remat: bool = False  # jax.checkpoint each block: trade recompute
+    # for ~depth x less activation memory — lets a federation of many
+    # ViT replicas (vmapped per-node weights) fit a single chip's HBM
+    scan_layers: bool = False  # nn.scan over depth: XLA compiles ONE
+    # block instead of `depth` unrolled copies (params gain a leading
+    # [depth] axis) — cuts compile time ~depth x for deep stacks
 
     @nn.compact
     def __call__(self, x):
@@ -89,11 +110,22 @@ class ViT(nn.Module):
         pos = self.param("pos_embed", nn.initializers.normal(0.02),
                          (1, h * w, c), self.param_dtype)
         x = x + pos.astype(self.dtype)
-        for _ in range(self.depth):
-            x = TransformerBlock(self.dim, self.heads, dtype=self.dtype,
-                                 param_dtype=self.param_dtype,
-                                 seq_axis=self.seq_axis,
-                                 use_flash=self.use_flash)(x)
+        block_cls = nn.remat(TransformerBlock) if self.remat else TransformerBlock
+        block_kw = dict(dim=self.dim, heads=self.heads, dtype=self.dtype,
+                        param_dtype=self.param_dtype,
+                        seq_axis=self.seq_axis, use_flash=self.use_flash)
+        if self.scan_layers:
+            scanned = nn.scan(
+                _BlockStep,
+                variable_axes={"params": 0},
+                split_rngs={"params": True},
+                length=self.depth,
+            )
+            x, _ = scanned(remat=self.remat, block_kw=block_kw,
+                           name="blocks")(x, None)
+        else:
+            for _ in range(self.depth):
+                x = block_cls(**block_kw)(x)
         x = nn.LayerNorm(dtype=self.dtype, param_dtype=self.param_dtype)(x)
         x = jnp.mean(x, axis=1)
         x = nn.Dense(self.num_classes, dtype=self.dtype,

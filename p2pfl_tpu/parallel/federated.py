@@ -110,14 +110,20 @@ def _train_and_select(fns: StepFns, states: TrainState, alive, trains,
     """Local epochs on every node, keeping updates only where
     ``trains & alive`` (proxy/idle/dead nodes stay frozen —
     node.py:492-524). Shared by the dense and sparse round builders so
-    training-selection semantics can't drift between them."""
-    new_states, train_metrics = jax.vmap(
-        fns.train_epochs, in_axes=(0, 0, 0, 0, None)
-    )(states, x, y, smask, epochs)
+    training-selection semantics can't drift between them.
+
+    The selection rides into the SGD step as a per-node update gate
+    (learner.train_epochs ``gate``) rather than a post-hoc full-tree
+    ``where`` — gated-off params are bit-exact and the round saves two
+    whole-model memory passes (~12 ms at the 64-node north star). Only
+    the small rng/step leaves still need explicit selection."""
     sel = jnp.logical_and(trains, alive)
+    new_states, train_metrics = jax.vmap(
+        fns.train_epochs, in_axes=(0, 0, 0, 0, None, 0)
+    )(states, x, y, smask, epochs, sel.astype(jnp.float32))
     states = TrainState(
-        params=_tree_sel(sel, new_states.params, states.params),
-        opt_state=_tree_sel(sel, new_states.opt_state, states.opt_state),
+        params=new_states.params,
+        opt_state=new_states.opt_state,
         rng=jnp.where(sel[:, None], new_states.rng, states.rng),
         step=jnp.where(sel, new_states.step, states.step),
     )
@@ -155,6 +161,7 @@ def build_round_fn(
     fns: StepFns,
     aggregator: Aggregator | None = None,
     epochs: int = 1,
+    exchange_dtype: Any | None = None,
 ) -> Callable:
     """Build the jittable ``round_fn(fed, x, y, mask, n_samples, plan
     arrays) -> (fed, metrics)``.
@@ -164,6 +171,16 @@ def build_round_fn(
     weight matrix folding topology × alive × sample counts. Robust
     aggregators (Krum/median/trimmed mean) are vmapped per row over the
     gathered stack.
+
+    ``exchange_dtype`` (e.g. ``jnp.bfloat16``) down-casts the model
+    stack entering the FedAvg contraction — halving the exchange's HBM
+    (and, sharded, ICI) bytes; accumulation stays f32 via
+    ``preferred_element_type``. The reference moves f32 pickles
+    (lightninglearner.py:73-77); bf16-rounding gossip inputs costs
+    ~0.4% relative weight error, re-trained away within the next local
+    epoch — the bench's rounds-to-80% guards the claim empirically.
+    ``None`` keeps the exchange in full precision (the parity-test
+    default).
     """
     aggregator = aggregator or FedAvg()
     fedavg_fast = type(aggregator) is FedAvg
@@ -186,16 +203,31 @@ def build_round_fn(
             wn = w / denom
 
             def leaf_mix(p):
-                flat = p.reshape(p.shape[0], -1).astype(jnp.float32)
-                out = wn @ flat  # [n,n]@[n,d] — MXU
+                mix_dt = exchange_dtype or jnp.float32
+                flat = p.reshape(p.shape[0], -1).astype(mix_dt)
+                out = jax.lax.dot(  # [n,n]@[n,d] — MXU, f32 accumulate
+                    wn.astype(mix_dt), flat,
+                    preferred_element_type=jnp.float32,
+                )
                 return out.reshape(p.shape).astype(p.dtype)
 
             agg = jax.tree.map(leaf_mix, states.params)
         else:
+            # wire-precision semantics for robust aggregators too: the
+            # stack entering aggregation is what crosses the "wire"
+            stack_ex = (
+                states.params if exchange_dtype is None
+                else jax.tree.map(lambda p: p.astype(exchange_dtype),
+                                  states.params)
+            )
+
             def per_row(row_w):
-                return aggregator.aggregate(
-                    states.params, n_samples.astype(jnp.float32),
+                out = aggregator.aggregate(
+                    stack_ex, n_samples.astype(jnp.float32),
                     mask=row_w > 0,
+                )
+                return jax.tree.map(
+                    lambda o, p: o.astype(p.dtype), out, states.params
                 )
 
             agg = jax.vmap(per_row)(w)
@@ -226,6 +258,7 @@ def build_round_fn_sparse(
     topology: Topology,
     mesh,
     epochs: int = 1,
+    exchange_dtype: Any | None = None,
 ) -> Callable:
     """The sparse-topology round: O(degree) ``ppermute`` hops over ICI
     instead of the dense all-gather einsum.
@@ -235,7 +268,11 @@ def build_round_fn_sparse(
     route everything through one leader, where a gather is the natural
     collective, so they stay on :func:`build_round_fn`). The per-round
     plan arrays keep the SAME signature as the dense round fn, so the
-    two programs are drop-in interchangeable and parity-testable.
+    two programs are drop-in interchangeable and parity-testable
+    (exact parity with ``exchange_dtype=None``; a wire dtype rounds
+    wire payloads identically on both paths but the dense einsum
+    additionally rounds the [n,n] weight matrix — see
+    ``neighbor_exchange``).
 
     On a ring (the reference's watts_strogatz(n,2,0) topology,
     topologymanager.py:213-228) this moves 2 × |params| per node per
@@ -271,7 +308,8 @@ def build_round_fn_sparse(
         my_w = (n_samples.astype(jnp.float32) * contrib)[0]
         local = jax.tree.map(lambda p: p[0], states.params)
         agg, total = neighbor_exchange(
-            local, my_w, mix[0], topology, NODES_AXIS
+            local, my_w, mix[0], topology, NODES_AXIS,
+            exchange_dtype=exchange_dtype,
         )
         keep = jnp.logical_and(alive[0], total > 0)
         params = jax.tree.map(

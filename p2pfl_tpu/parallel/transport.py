@@ -58,6 +58,7 @@ def neighbor_exchange(
     row: jnp.ndarray,
     topology: Topology,
     axis_name: str = NODES_AXIS,
+    exchange_dtype: Any | None = None,
 ) -> tuple[Any, jnp.ndarray]:
     """Weighted neighborhood average via ``ppermute`` — for use inside
     ``shard_map`` with one node per mesh slot.
@@ -77,16 +78,29 @@ def neighbor_exchange(
     Returns ``(mean_f32, total_weight)``; the caller keeps its own
     params where ``total_weight == 0`` (the nothing-arrived timeout
     analog, aggregator.py:53-76).
+
+    ``exchange_dtype`` (e.g. bf16) down-casts params before each
+    ``ppermute`` — halving ICI bytes per hop; accumulation stays f32.
+    The self contribution goes through the same wire cast so every
+    model entering the aggregation saw identical rounding (matching
+    the dense einsum's whole-stack cast). Exact dense/sparse parity
+    holds for ``exchange_dtype=None`` (the default): with a wire dtype
+    the two schedules still agree on what crosses the wire but differ
+    in weight rounding and accumulation order.
     """
     n = topology.n
     idx = jax.lax.axis_index(axis_name)
     w_self = row[idx] * my_weight
-    acc = jax.tree.map(lambda p: p.astype(jnp.float32) * w_self, params)
+    wire = (
+        params if exchange_dtype is None
+        else jax.tree.map(lambda p: p.astype(exchange_dtype), params)
+    )
+    acc = jax.tree.map(lambda p: p.astype(jnp.float32) * w_self, wire)
     total = w_self
     for k in edge_offsets(topology):
         perm = [(i, (i + k) % n) for i in range(n)]  # src -> dst
         shifted = jax.tree.map(
-            lambda p: jax.lax.ppermute(p, axis_name, perm), params
+            lambda p: jax.lax.ppermute(p, axis_name, perm), wire
         )
         w_recv = jax.lax.ppermute(my_weight, axis_name, perm)
         sender = (idx - k) % n

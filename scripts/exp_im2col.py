@@ -1,0 +1,150 @@
+"""Experiment: vmapped nn.Conv (grouped-conv lowering) vs im2col +
+batched einsum for the per-node-weights FEMNIST CNN training step.
+
+Hypothesis: vmap over per-node conv kernels lowers to
+feature_group_count grouped convs whose per-group contraction dims
+(25 / 800) pad badly on the MXU; expressing the conv as patch
+extraction + einsum turns the whole step into batched GEMMs.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+def amortized(fn, sync, k=10, reps=3):
+    import numpy as np
+
+    out = fn()
+    sync(out)
+    times = []
+    for _ in range(reps):
+        t0 = time.monotonic()
+        for _ in range(k):
+            out = fn()
+        sync(out)
+        times.append((time.monotonic() - t0) / k)
+    return float(np.median(times))
+
+
+def main() -> None:
+    from p2pfl_tpu.learning.learner import make_step_fns
+    from p2pfl_tpu.learning.objectives import get_objective
+    from p2pfl_tpu.models import get_model
+
+    n, bsz = 64, 64
+    key = jax.random.PRNGKey(0)
+    x = jax.random.normal(key, (n, bsz, 28, 28, 1), jnp.float32)
+    y = jnp.zeros((n, bsz), jnp.int32)
+    mask = jnp.ones((n, bsz), bool)
+    loss_fn = get_objective("classification")
+    tx = optax.sgd(0.05, momentum=0.9)
+
+    def bench_model(model, tag):
+        fns = make_step_fns(model, learning_rate=0.05, batch_size=bsz)
+        rngs = jnp.stack([jax.random.PRNGKey(0)] * n)
+        states = jax.jit(jax.vmap(fns.init, in_axes=(0, None)))(rngs, x[0, :1])
+
+        def per_node(st, xb, yb, mb):
+            def batch_loss(p):
+                return loss_fn(model.apply(p, xb), yb, mb)
+            loss, grads = jax.value_and_grad(batch_loss)(st.params)
+            updates, opt_state = tx.update(grads, st.opt_state, st.params)
+            params = optax.apply_updates(st.params, updates)
+            return st.replace(params=params, opt_state=opt_state), loss
+
+        step = jax.jit(jax.vmap(per_node))
+        t = amortized(lambda: step(states, x, y, mask),
+                      lambda o: float(jnp.sum(o[1])))
+        print(f"{tag:24s} {t*1000:8.2f} ms/step")
+        return states
+
+    bench_model(get_model("femnist-cnn"), "nn.Conv (current)")
+
+    # --- im2col variant ------------------------------------------------
+    import flax.linen as nn
+
+    class Im2ColConv(nn.Module):
+        features: int
+        kernel: int = 5
+        dtype: jnp.dtype = jnp.bfloat16
+        param_dtype: jnp.dtype = jnp.float32
+
+        @nn.compact
+        def __call__(self, x):
+            k = self.kernel
+            cin = x.shape[-1]
+            w = self.param(
+                "kernel", nn.initializers.lecun_normal(),
+                (k * k * cin, self.features), self.param_dtype,
+            )
+            b = self.param("bias", nn.initializers.zeros,
+                           (self.features,), self.param_dtype)
+            patches = jax.lax.conv_general_dilated_patches(
+                x.astype(self.dtype), (k, k), (1, 1), "SAME",
+                dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            )  # [B,H,W,cin*k*k]
+            out = patches @ w.astype(self.dtype)
+            return out + b.astype(self.dtype)
+
+    class CNN2(nn.Module):
+        dtype: jnp.dtype = jnp.bfloat16
+        param_dtype: jnp.dtype = jnp.float32
+
+        @nn.compact
+        def __call__(self, x):
+            if x.ndim == 3:
+                x = x[..., None]
+            x = x.astype(self.dtype)
+            for c in (32, 64):
+                x = Im2ColConv(features=c, kernel=5, dtype=self.dtype,
+                               param_dtype=self.param_dtype)(x)
+                x = nn.relu(x)
+                x = nn.max_pool(x, (2, 2), strides=(2, 2))
+            x = x.reshape((x.shape[0], -1))
+            x = nn.Dense(2048, dtype=self.dtype,
+                         param_dtype=self.param_dtype)(x)
+            x = nn.relu(x)
+            x = nn.Dense(62, dtype=self.dtype, param_dtype=self.param_dtype)(x)
+            return x.astype(jnp.float32)
+
+    bench_model(CNN2(), "im2col einsum")
+
+    # --- batch 128 variant of both (MXU M-dim util) --------------------
+    global_x = jax.random.normal(key, (n, 128, 28, 28, 1), jnp.float32)
+    global_y = jnp.zeros((n, 128), jnp.int32)
+    global_m = jnp.ones((n, 128), bool)
+
+    def bench_model_b(model, tag, bx, by, bm):
+        fns = make_step_fns(model, learning_rate=0.05, batch_size=bx.shape[1])
+        rngs = jnp.stack([jax.random.PRNGKey(0)] * n)
+        states = jax.jit(jax.vmap(fns.init, in_axes=(0, None)))(rngs, bx[0, :1])
+
+        def per_node(st, xb, yb, mb):
+            def batch_loss(p):
+                return loss_fn(model.apply(p, xb), yb, mb)
+            loss, grads = jax.value_and_grad(batch_loss)(st.params)
+            updates, opt_state = tx.update(grads, st.opt_state, st.params)
+            params = optax.apply_updates(st.params, updates)
+            return st.replace(params=params, opt_state=opt_state), loss
+
+        step = jax.jit(jax.vmap(per_node))
+        t = amortized(lambda: step(states, bx, by, bm),
+                      lambda o: float(jnp.sum(o[1])))
+        print(f"{tag:24s} {t*1000:8.2f} ms/step (batch {bx.shape[1]})")
+
+    bench_model_b(get_model("femnist-cnn"), "nn.Conv b128",
+                  global_x, global_y, global_m)
+    bench_model_b(CNN2(), "im2col b128", global_x, global_y, global_m)
+
+
+if __name__ == "__main__":
+    main()

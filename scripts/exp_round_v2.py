@@ -1,0 +1,65 @@
+"""Measure the optimized round (one-hot shuffle + update gate + bf16
+exchange) end-to-end at batch 64 vs 128, amortized over 10 chained
+dispatches (single sync)."""
+
+from __future__ import annotations
+
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import jax
+import jax.numpy as jnp
+
+
+def run(batch_size, exchange_dtype, tag):
+    import numpy as np
+
+    from p2pfl_tpu.config.schema import DataConfig
+    from p2pfl_tpu.datasets import FederatedDataset
+    from p2pfl_tpu.learning.learner import make_step_fns
+    from p2pfl_tpu.models import get_model
+    from p2pfl_tpu.parallel.federated import (
+        build_round_fn,
+        init_federation,
+        make_round_plan,
+    )
+    from p2pfl_tpu.parallel.transport import MeshTransport
+    from p2pfl_tpu.topology.topology import generate_topology
+
+    n = 64
+    ds = FederatedDataset.make(
+        DataConfig(dataset="femnist", samples_per_node=750,
+                   batch_size=batch_size), n)
+    x, y, smask, nsamp = ds.stacked()
+    fns = make_step_fns(get_model("femnist-cnn"), learning_rate=0.05,
+                        batch_size=batch_size)
+    topo = generate_topology("ring", n)
+    plan = make_round_plan(topo, ["aggregator"] * n, "DFL")
+    tr = MeshTransport(n)
+    fed = tr.put_stacked(init_federation(fns, jnp.asarray(x[0, :1]), n))
+    fargs = [tr.put_stacked(jnp.asarray(a))
+             for a in (x, y, smask, nsamp, plan.mix, plan.adopt, plan.trains)]
+    round_fn = jax.jit(build_round_fn(fns, epochs=1,
+                                      exchange_dtype=exchange_dtype),
+                       donate_argnums=(0,))
+    fed, m = round_fn(fed, *fargs)
+    float(jnp.sum(m["train_loss"]))
+    k = 10
+    ts = []
+    for _ in range(3):
+        t0 = time.monotonic()
+        for _ in range(k):
+            fed, m = round_fn(fed, *fargs)
+        float(jnp.sum(m["train_loss"]))
+        ts.append((time.monotonic() - t0) / k)
+    print(f"{tag:30s} {float(np.median(ts))*1000:8.1f} ms/round", flush=True)
+
+
+if __name__ == "__main__":
+    run(64, None, "b64 f32-exchange")
+    run(64, jnp.bfloat16, "b64 bf16-exchange")
+    run(128, jnp.bfloat16, "b128 bf16-exchange")
+    run(256, jnp.bfloat16, "b256 bf16-exchange")

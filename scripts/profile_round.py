@@ -1,0 +1,159 @@
+"""Component-wise timing of the north-star round (VERDICT r2 #1).
+
+Breaks the 64-node FEMNIST-CNN round into its constituent programs and
+times each on the real chip, so docs/perf.md names the sinks with
+measurements instead of guesses. Optionally writes a jax.profiler trace
+of the steady-state round (--trace DIR).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def _t(fn, *args, reps=5, sync=None):
+    """Median wall-clock of fn(*args); sync forces a host fetch."""
+    import numpy as np
+
+    out = fn(*args)
+    if sync:
+        sync(out)
+    times = []
+    for _ in range(reps):
+        t0 = time.monotonic()
+        out = fn(*args)
+        if sync:
+            sync(out)
+        times.append(time.monotonic() - t0)
+    return float(np.median(times)), out
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--trace", default=None, help="profiler trace dir")
+    ap.add_argument("-n", type=int, default=64)
+    args_cli = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from p2pfl_tpu.config.schema import DataConfig
+    from p2pfl_tpu.datasets import FederatedDataset
+    from p2pfl_tpu.learning.learner import make_step_fns
+    from p2pfl_tpu.models import get_model
+    from p2pfl_tpu.parallel.federated import (
+        build_round_fn,
+        init_federation,
+        make_round_plan,
+    )
+    from p2pfl_tpu.parallel.transport import MeshTransport
+    from p2pfl_tpu.topology.topology import generate_topology
+
+    n = args_cli.n
+    ds = FederatedDataset.make(
+        DataConfig(dataset="femnist", samples_per_node=750, batch_size=64), n
+    )
+    x, y, smask, nsamp = ds.stacked()
+    fns = make_step_fns(get_model("femnist-cnn"), learning_rate=0.05,
+                        batch_size=64)
+    topo = generate_topology("ring", n)
+    plan = make_round_plan(topo, ["aggregator"] * n, "DFL")
+    tr = MeshTransport(n)
+    fed = tr.put_stacked(init_federation(fns, jnp.asarray(x[0, :1]), n))
+    fargs = [tr.put_stacked(jnp.asarray(a))
+             for a in (x, y, smask, nsamp, plan.mix, plan.adopt, plan.trains)]
+    xs, ys, ms = fargs[0], fargs[1], fargs[2]
+
+    def sync_metrics(out):
+        float(jnp.sum(out[1]["train_loss"]))
+
+    def sync_leaf(out):
+        leaf = jax.tree.leaves(out)[0]
+        float(jnp.sum(leaf if leaf.dtype != bool else leaf.astype(jnp.int32)))
+
+    # ---- 1. full round (bench parity; NOT donated so we can re-call) --
+    round_fn = jax.jit(build_round_fn(fns, epochs=1))
+    t_round, _ = _t(lambda: round_fn(fed, *fargs), sync=sync_metrics)
+
+    # ---- 2. training only (vmapped epochs, no exchange) ---------------
+    train_v = jax.jit(jax.vmap(fns.train_epochs, in_axes=(0, 0, 0, 0, None)),
+                      static_argnums=(4,))
+    t_train, _ = _t(lambda: train_v(fed.states, xs, ys, ms, 1),
+                    sync=lambda o: float(jnp.sum(o[1]["loss"])))
+
+    # ---- 3. mixing einsum only ----------------------------------------
+    wn = fargs[4] / jnp.maximum(jnp.sum(fargs[4], axis=1, keepdims=True), 1e-9)
+
+    def mix_only(params, w):
+        def leaf(p):
+            flat = p.reshape(p.shape[0], -1).astype(jnp.float32)
+            return (w @ flat).reshape(p.shape).astype(p.dtype)
+        return jax.tree.map(leaf, params)
+
+    mix_jit = jax.jit(mix_only)
+    t_mix, _ = _t(lambda: mix_jit(fed.states.params, wn), sync=sync_leaf)
+
+    # ---- 4. the per-epoch permutation gather alone --------------------
+    def gather_only(xx, yy, mm, rng):
+        def one(xn, yn, mn, r):
+            perm = jax.random.permutation(r, xn.shape[0])
+            return xn[perm], yn[perm], mn[perm]
+        rngs = jax.random.split(rng, xx.shape[0])
+        return jax.vmap(one)(xx, yy, mm, rngs)
+
+    g_jit = jax.jit(gather_only)
+    key = jax.random.PRNGKey(0)
+    t_gather, _ = _t(lambda: g_jit(xs, ys, ms, key), sync=sync_leaf)
+
+    # ---- 5. single SGD step, batch 64x64 (per-step floor) -------------
+    def one_step(states, bx, by, bm):
+        import optax
+
+        from p2pfl_tpu.learning.objectives import get_objective
+        loss_fn = get_objective("classification")
+        model = get_model("femnist-cnn")
+
+        def per_node(st, xb, yb, mb):
+            def batch_loss(p):
+                return loss_fn(model.apply(p, xb), yb, mb)
+            loss, grads = jax.value_and_grad(batch_loss)(st.params)
+            updates, opt_state = fns.tx.update(grads, st.opt_state, st.params)
+            params = optax.apply_updates(st.params, updates)
+            return st.replace(params=params, opt_state=opt_state), loss
+
+        return jax.vmap(per_node)(states, bx, by, bm)
+
+    step_jit = jax.jit(one_step)
+    bx, by, bm = xs[:, :64], ys[:, :64], ms[:, :64]
+    t_step, _ = _t(lambda: step_jit(fed.states, bx, by, bm),
+                   sync=lambda o: float(jnp.sum(o[1])))
+
+    # ---- 6. null program: dispatch+sync floor on this backend ---------
+    null_jit = jax.jit(lambda s: jnp.sum(s) + 1.0)
+    small = jnp.zeros((8,))
+    t_null, _ = _t(lambda: null_jit(small), sync=lambda o: float(o))
+
+    steps = 750 // 64
+    print(f"n={n} device={jax.devices()[0].device_kind}")
+    print(f"full_round_s       {t_round:.4f}")
+    print(f"train_only_s       {t_train:.4f}")
+    print(f"mix_einsum_s       {t_mix:.4f}")
+    print(f"perm_gather_s      {t_gather:.4f}")
+    print(f"one_sgd_step_s     {t_step:.4f}  (x{steps} steps = {t_step*steps:.4f})")
+    print(f"dispatch_floor_s   {t_null:.4f}")
+
+    if args_cli.trace:
+        with jax.profiler.trace(args_cli.trace):
+            out = round_fn(fed, *fargs)
+            sync_metrics(out)
+        print(f"trace written to {args_cli.trace}")
+
+
+if __name__ == "__main__":
+    main()

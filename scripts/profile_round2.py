@@ -1,0 +1,124 @@
+"""Amortized timing: K back-to-back dispatches, one sync, divide by K.
+
+Removes the ~110 ms axon-tunnel dispatch floor that pollutes per-call
+measurements (scripts/profile_round.py showed a null program costs
+0.11 s). Dispatches pipeline on the device queue, so K chained calls
+measure true device time once K is large enough.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def amortized(fn, sync, k=10, reps=3):
+    import numpy as np
+
+    out = fn()  # warmup/compile
+    sync(out)
+    times = []
+    for _ in range(reps):
+        t0 = time.monotonic()
+        for _ in range(k):
+            out = fn()
+        sync(out)
+        times.append((time.monotonic() - t0) / k)
+    return float(np.median(times))
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from p2pfl_tpu.config.schema import DataConfig
+    from p2pfl_tpu.datasets import FederatedDataset
+    from p2pfl_tpu.learning.learner import make_step_fns
+    from p2pfl_tpu.models import get_model
+    from p2pfl_tpu.parallel.federated import (
+        build_round_fn,
+        init_federation,
+        make_round_plan,
+    )
+    from p2pfl_tpu.parallel.transport import MeshTransport
+    from p2pfl_tpu.topology.topology import generate_topology
+
+    n = 64
+    ds = FederatedDataset.make(
+        DataConfig(dataset="femnist", samples_per_node=750, batch_size=64), n
+    )
+    x, y, smask, nsamp = ds.stacked()
+    fns = make_step_fns(get_model("femnist-cnn"), learning_rate=0.05,
+                        batch_size=64)
+    topo = generate_topology("ring", n)
+    plan = make_round_plan(topo, ["aggregator"] * n, "DFL")
+    tr = MeshTransport(n)
+    fed = tr.put_stacked(init_federation(fns, jnp.asarray(x[0, :1]), n))
+    fargs = [tr.put_stacked(jnp.asarray(a))
+             for a in (x, y, smask, nsamp, plan.mix, plan.adopt, plan.trains)]
+    xs, ys, ms = fargs[0], fargs[1], fargs[2]
+
+    def sm(out):
+        float(jnp.sum(out[1]["train_loss"]))
+
+    def sl(out):
+        leaf = jax.tree.leaves(out)[0]
+        float(jnp.sum(leaf.astype(jnp.float32)))
+
+    round_fn = jax.jit(build_round_fn(fns, epochs=1))
+    t_round = amortized(lambda: round_fn(fed, *fargs), sm)
+
+    train_v = jax.jit(jax.vmap(fns.train_epochs, in_axes=(0, 0, 0, 0, None)),
+                      static_argnums=(4,))
+    t_train = amortized(lambda: train_v(fed.states, xs, ys, ms, 1),
+                        lambda o: float(jnp.sum(o[1]["loss"])))
+
+    wn = fargs[4] / jnp.maximum(jnp.sum(fargs[4], axis=1, keepdims=True), 1e-9)
+
+    def mix_only(params, w):
+        def leaf(p):
+            flat = p.reshape(p.shape[0], -1).astype(jnp.float32)
+            return (w @ flat).reshape(p.shape).astype(p.dtype)
+        return jax.tree.map(leaf, params)
+
+    mix_jit = jax.jit(mix_only)
+    t_mix = amortized(lambda: mix_jit(fed.states.params, wn), sl)
+
+    def gather_only(xx, yy, mm, rng):
+        def one(xn, yn, mn, r):
+            perm = jax.random.permutation(r, xn.shape[0])
+            return xn[perm], yn[perm], mn[perm]
+        rngs = jax.random.split(rng, xx.shape[0])
+        return jax.vmap(one)(xx, yy, mm, rngs)
+
+    g_jit = jax.jit(gather_only)
+    key = jax.random.PRNGKey(0)
+    t_gather = amortized(lambda: g_jit(xs, ys, ms, key), sl)
+
+    # one-hot matmul permutation of x only (the heavy leaf)
+    def gather_matmul(xx, rng):
+        def one(xn, r):
+            perm = jax.random.permutation(r, xn.shape[0])
+            oh = jax.nn.one_hot(perm, xn.shape[0], dtype=jnp.bfloat16)
+            flat = xn.reshape(xn.shape[0], -1).astype(jnp.bfloat16)
+            return (oh @ flat).reshape(xn.shape)
+        rngs = jax.random.split(rng, xx.shape[0])
+        return jax.vmap(one)(xx, rngs)
+
+    gm_jit = jax.jit(gather_matmul)
+    t_gather_mm = amortized(lambda: gm_jit(xs, key), sl)
+
+    print(f"n={n} amortized over 10 dispatches")
+    print(f"full_round_s       {t_round:.4f}")
+    print(f"train_only_s       {t_train:.4f}")
+    print(f"mix_einsum_s       {t_mix:.4f}")
+    print(f"perm_gather_s      {t_gather:.4f}")
+    print(f"perm_onehot_mm_s   {t_gather_mm:.4f}")
+    print(f"implied step_s     {(t_train - t_gather) / 11:.4f} (train minus gather / 11)")
+
+
+if __name__ == "__main__":
+    main()

@@ -4,11 +4,13 @@ ViT-Tiny-32-Krum) run end-to-end.
 
 Primary metric (BASELINE.json north star): steady-state wall-clock per
 federated round for a **64-node FEMNIST-CNN** federation (ring
-topology, FedAvg, 1 local epoch, batch 150, lr 0.05 — swept
-{64,128,150,250}x{0.05,0.065,0.08,0.12,0.15}: larger batches cut the
-round's HBM-bound weight-state traffic (fewer SGD steps over the same
-6.4M params/node — see docs/perf.md roofline), and 150@0.05 gives the
-best seconds-to-80% while 64@0.05 still wins rounds-to-80%) on the
+topology, FedAvg, 1 local epoch over a genuinely-750-sample/node
+surrogate shard — 675 train rows after the 10% val split, which
+BENCH_r01/r02 silently capped at 338 (surrogate size); batch 224, lr
+0.05 — swept {64..672}x{0.05..0.15}: large batches cut both the
+HBM-bound weight-state passes and per-step launch overhead, batch
+shape matters (224 = 7x32 tiles well where 135/150 lower ~35% slower),
+and 224@0.05 wins seconds-to-80% outright — see docs/perf.md) on the
 available TPU device(s) — one vmapped SPMD program; on a pod slice the
 same program shards 1 node/chip.
 
@@ -43,9 +45,13 @@ Extra keys in the same JSON line:
   reference's CIFAR CNN, cifar10/models/resnet.py), 16 nodes, random
   topology, Dirichlet(0.5) non-IID shards, FedAvg;
 - ``vit32_*``: BASELINE.json configs[4] (stretch) — ViT-Tiny, 32
-  nodes, Krum aggregator, Pallas flash attention (use_flash=True);
+  nodes, Krum aggregator, Pallas flash attention
+  (``vit32_used_flash_attention`` records whether the Pallas path ran
+  or the XLA-attention fallback did);
 - ``cpu8_ring_*``: both collective schedules (dense all-gather einsum
-  vs O(degree) ppermute) on an 8-device virtual CPU mesh.
+  vs O(degree) ppermute) on an 8-device virtual CPU mesh;
+- ``socket_round_s_24node``: the SOCKET path at 24 nodes (in-process
+  simulation mode, fan-out-capped control floods, CPU subprocess).
 """
 
 from __future__ import annotations
@@ -78,7 +84,7 @@ def _peak_flops(device) -> float | None:
 
 def _build(n: int, *, dataset="femnist", model="femnist-cnn",
            topology="ring", aggregator=None, partition="iid",
-           samples_per_node=750, batch_size=150, learning_rate=0.05,
+           samples_per_node=750, batch_size=224, learning_rate=0.05,
            optimizer="sgd", exchange_dtype="bf16", seed=0,
            model_kwargs=None):
     """Assemble one federated configuration into compiled programs.
@@ -99,10 +105,15 @@ def _build(n: int, *, dataset="femnist", model="femnist-cnn",
     from p2pfl_tpu.parallel.transport import MeshTransport
     from p2pfl_tpu.topology.topology import generate_topology
 
+    # size the surrogate so samples_per_node is actually delivered —
+    # the default synthetic fallback (~24k train) would silently cap a
+    # 64 x 750 federation at ~338 samples/node (as BENCH_r01/r02 did)
+    need = int(n * samples_per_node / 0.9) + n  # val split headroom
     ds = FederatedDataset.make(
         DataConfig(dataset=dataset, samples_per_node=samples_per_node,
                    batch_size=batch_size, partition=partition,
-                   dirichlet_alpha=0.5, seed=seed),
+                   dirichlet_alpha=0.5, seed=seed,
+                   synthetic_train=need),
         n,
     )
     x, y, smask, nsamp = ds.stacked()
@@ -267,12 +278,26 @@ def _accuracy_run(run, target: float = 0.80, max_rounds: int = 30,
     import jax.numpy as jnp
     import numpy as np
 
+    # the timing federation's buffers are dead weight here — a
+    # federation state is ~2 x |params| x n_nodes (3.3 GB at the north
+    # star), and holding three of them at once OOMs a 16 GB chip
+    run["fed"] = None
     traj, eval_fn, _, _ = _make_trajectory(run, max_rounds)
     fed0 = run["reset"](1)
     fed_end, accs = traj(fed0, max_rounds)  # includes compile
+    del fed0
     accs = np.asarray(accs)
     hit = accs >= target
     r80 = int(np.argmax(hit)) + 1 if hit.any() else None
+
+    # final accuracy on the FULL test set, then release that state
+    # before the timed re-run needs its own
+    ds, tr = run["ds"], run["tr"]
+    xt_full = tr.put_replicated(jnp.asarray(ds.x_test))
+    yt_full = tr.put_replicated(jnp.asarray(ds.y_test))
+    final = float(np.mean(np.asarray(
+        eval_fn(fed_end, xt_full, yt_full)["accuracy"])))
+    del fed_end, xt_full, yt_full
 
     seconds = None
     if r80 is not None and measure_seconds:
@@ -282,11 +307,6 @@ def _accuracy_run(run, target: float = 0.80, max_rounds: int = 30,
         float(jnp.sum(accs2))
         seconds = round(time.monotonic() - t0, 3)
 
-    ds, tr = run["ds"], run["tr"]
-    xt_full = tr.put_replicated(jnp.asarray(ds.x_test))
-    yt_full = tr.put_replicated(jnp.asarray(ds.y_test))
-    final = float(np.mean(np.asarray(
-        eval_fn(fed_end, xt_full, yt_full)["accuracy"])))
     return r80, seconds, final, accs
 
 
@@ -363,6 +383,12 @@ def _cifar16() -> dict:
     """BASELINE.json configs[2]: CIFAR10 ResNet9, 16 nodes, random
     topology, Dirichlet(0.5) shards, FedAvg. Reports steady-state
     round time, accuracy after 40 rounds, and data provenance."""
+    import gc
+
+    import jax
+
+    jax.clear_caches()  # free the headline configs' programs + buffers
+    gc.collect()
     try:
         run = _build(16, dataset="cifar10", model="resnet9",
                      topology="random", partition="dirichlet",
@@ -388,29 +414,95 @@ def _vit32() -> dict:
     """BASELINE.json configs[4] (stretch): ViT-Tiny, 32 nodes, Krum
     aggregator, Pallas flash attention — the first on-TPU federation
     exercising ops.flash under the robust-aggregation path."""
-    try:
-        from p2pfl_tpu.core.aggregators import Krum
+    import gc
+    import sys
 
-        run = _build(32, dataset="cifar10", model="vit-tiny",
-                     topology="fully", aggregator=Krum(f=1, m=3),
-                     partition="iid", samples_per_node=512,
-                     batch_size=115, learning_rate=1e-3,
-                     optimizer="adam", seed=4,
-                     model_kwargs={"use_flash": True, "remat": True,
-                                   "scan_layers": True})
-        round_s = _time_chained(run, k=5, reps=3)
-        _, _, final, accs = _accuracy_run(run, target=0.80, max_rounds=20,
-                                          measure_seconds=False)
-        return {
-            "vit32_krum_flash_round_s": round(round_s, 4),
-            "vit32_krum_flash_acc_20r": round(float(accs[19]), 4),
-            "vit32_krum_flash_final_acc": round(final, 4),
-            "vit32_synthetic_data": run["ds"].synthetic,
-        }
+    import jax
+
+    from p2pfl_tpu.core.aggregators import Krum
+
+    # release every earlier config's compiled programs + buffers: the
+    # Pallas flash kernels are sensitive to a fragmented HBM (observed:
+    # a run that succeeds on a fresh process can fault the TPU worker
+    # after the cifar16 config's allocations)
+    jax.clear_caches()
+    gc.collect()
+    for use_flash in (True, False):
+        try:
+            run = _build(32, dataset="cifar10", model="vit-tiny",
+                         topology="fully", aggregator=Krum(f=1, m=3),
+                         partition="iid", samples_per_node=512,
+                         batch_size=115, learning_rate=1e-3,
+                         optimizer="adam", seed=4,
+                         model_kwargs={"use_flash": use_flash,
+                                       "remat": True,
+                                       "scan_layers": True})
+            round_s = _time_chained(run, k=5, reps=3)
+            _, _, final, accs = _accuracy_run(run, target=0.80,
+                                              max_rounds=20,
+                                              measure_seconds=False)
+            return {
+                "vit32_krum_round_s": round(round_s, 4),
+                "vit32_krum_acc_20r": round(float(accs[19]), 4),
+                "vit32_krum_final_acc": round(final, 4),
+                "vit32_used_flash_attention": use_flash,
+                "vit32_synthetic_data": run["ds"].synthetic,
+            }
+        except Exception as e:
+            print(f"vit32 (use_flash={use_flash}) failed: {e!r}",
+                  file=sys.stderr)
+            gc.collect()
+    return {"vit32_krum_round_s": None}
+
+
+def _socket24() -> dict:
+    """VERDICT r2 #6 metric: steady-state round time of a 24-node
+    SOCKET federation (fully connected, control-flood fan-out capped
+    at 6, binding train-set cap 8) in the in-process simulation mode.
+    Runs on the CPU backend in a subprocess — 24 asyncio nodes cannot
+    share the bench chip, and the socket path's cost is control-plane,
+    not compute."""
+    import json as _json
+    import subprocess
+    import sys
+
+    code = r"""
+import os, re, json
+flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+               os.environ.get("XLA_FLAGS", "")).strip()
+os.environ["XLA_FLAGS"] = flags
+import jax
+jax.config.update("jax_platforms", "cpu")
+import sys; sys.path.insert(0, %r)
+from p2pfl_tpu.config.schema import (ScenarioConfig, TrainingConfig,
+    ProtocolConfig, DataConfig)
+from p2pfl_tpu.p2p.launch import run_simulation
+cfg = ScenarioConfig(
+    name="sock24", n_nodes=24, topology="fully",
+    data=DataConfig(dataset="mnist", samples_per_node=60),
+    training=TrainingConfig(rounds=3, epochs_per_round=1,
+                            learning_rate=0.05),
+    protocol=ProtocolConfig(heartbeat_period_s=0.5,
+                            aggregation_timeout_s=60.0,
+                            vote_timeout_s=10.0, train_set_size=8,
+                            gossip_fanout=6),
+)
+print("BENCH_SOCK24 " + json.dumps(run_simulation(cfg, timeout=280)))
+""" % (str(__import__("pathlib").Path(__file__).resolve().parent),)
+    try:
+        res = subprocess.run([sys.executable, "-c", code],
+                             capture_output=True, text=True, timeout=500)
+        for line in res.stdout.splitlines():
+            if line.startswith("BENCH_SOCK24 "):
+                got = _json.loads(line[len("BENCH_SOCK24 "):])
+                return {"socket_round_s_24node": got.get("round_s"),
+                        "socket_24node_rounds": got.get("rounds")}
+        print(f"socket24 child rc={res.returncode}: {res.stderr[-400:]}",
+              file=sys.stderr)
     except Exception as e:
         import sys
-        print(f"vit32 config failed: {e!r}", file=sys.stderr)
-        return {"vit32_krum_flash_round_s": None}
+        print(f"socket24 failed: {e!r}", file=sys.stderr)
+    return {"socket_round_s_24node": None}
 
 
 def main() -> None:
@@ -436,6 +528,7 @@ def main() -> None:
     cifar = _cifar16()
     vit = _vit32()
     cpu8 = _sparse_vs_dense_cpu()
+    sock24 = _socket24()
 
     print(
         json.dumps(
@@ -460,6 +553,7 @@ def main() -> None:
                 **cifar,
                 **vit,
                 **cpu8,
+                **sock24,
             }
         )
     )

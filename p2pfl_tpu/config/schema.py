@@ -33,6 +33,13 @@ class DataConfig:
     batch_size: int = 32  # mnist.py:56
     val_percent: float = 0.1  # mnist.py:59
     seed: int = 0
+    # surrogate sizing when real files are absent: by default the
+    # synthetic fallback is ~20-24k train samples, which silently CAPS
+    # samples_per_node for large federations (64 x 750 needs ~53k).
+    # Set explicitly to generate a surrogate big enough for the
+    # federation you asked for. Ignored when real data exists.
+    synthetic_train: int | None = None
+    synthetic_test: int | None = None
 
 
 @dataclasses.dataclass
@@ -85,6 +92,29 @@ class ProtocolConfig:
     # analog (participant.json.example:81; the reference paces its
     # gossiper thread by frequency, here it is the sleep between ticks)
     gossip_period_s: float = 0.05
+    # control-flood relay fan-out (GOSSIP_MESSAGES_PER_ROUND analog,
+    # gossiper.py:66-112): when a node RE-forwards a flooded control
+    # message it relays to at most this many random peers instead of
+    # all of them — on dense overlays that turns O(peers^2) traffic per
+    # flood into O(peers * fanout) epidemic gossip (dedup keeps it
+    # at-most-once). <=0 floods to every peer (small-federation default;
+    # the origin's own broadcast always goes to all its peers).
+    gossip_fanout: int = 0
+
+
+@dataclasses.dataclass
+class NetworkConfig:
+    """Deterministic per-link network emulation on the socket path —
+    the tcset --delay/--loss analog (fedstellar/base_node.py:82-85,
+    participant.json.example:34-38), applied in-process and seeded so
+    a lossy-network test replays identically. All-zero = no shaping.
+    """
+
+    delay_ms: float = 0.0
+    jitter_ms: float = 0.0
+    loss_pct: float = 0.0
+    rate_mbps: float = 0.0  # link bandwidth; 0 = unlimited
+    seed: int = 0
 
 
 @dataclasses.dataclass
@@ -128,6 +158,7 @@ class ScenarioConfig:
     protocol: ProtocolConfig = dataclasses.field(default_factory=ProtocolConfig)
     aggregator: str = "fedavg"
     aggregator_kwargs: dict[str, Any] = dataclasses.field(default_factory=dict)
+    network: NetworkConfig = dataclasses.field(default_factory=NetworkConfig)
     # weight-exchange collective schedule: "dense" = all-gather einsum;
     # "sparse" = per-edge-offset ppermute (O(degree) ICI traffic, DFL +
     # one node per device only); "auto" picks sparse when it is legal
@@ -203,6 +234,7 @@ class ScenarioConfig:
             ("model", ModelConfig),
             ("training", TrainingConfig),
             ("protocol", ProtocolConfig),
+            ("network", NetworkConfig),
         ]:
             if field in d and isinstance(d[field], dict):
                 d[field] = cls(**d[field])

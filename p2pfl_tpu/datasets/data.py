@@ -83,7 +83,12 @@ class FederatedDataset:
     ) -> "FederatedDataset":
         """Build federated shards per the DataConfig partition scheme."""
         if splits is None:
-            splits = get_dataset(config.dataset, seed=config.seed)
+            sizes = (
+                (config.synthetic_train, config.synthetic_test or 4000)
+                if config.synthetic_train else None
+            )
+            splits = get_dataset(config.dataset, seed=config.seed,
+                                 synthetic_sizes=sizes)
         parts = partition_indices(
             splits.y_train, n_nodes, scheme=config.partition,
             seed=config.seed, alpha=config.dirichlet_alpha,

@@ -28,19 +28,43 @@ def checkpoint_path(directory: str | pathlib.Path, round_num: int) -> pathlib.Pa
 
 
 def save_checkpoint(directory: str | pathlib.Path, fed: FederatedState) -> pathlib.Path:
-    """Write the federation state; returns the file path."""
+    """Write the federation state; returns the file path.
+
+    Multi-host (jax.distributed): node-sharded leaves are only
+    partially addressable per process, so every process joins an
+    allgather and process 0 writes the file; a barrier afterwards
+    guarantees the checkpoint exists before any process moves on
+    (e.g. to a restart that would resume from it)."""
     directory = pathlib.Path(directory)
-    directory.mkdir(parents=True, exist_ok=True)
-    host = jax.tree.map(np.asarray, fed)
-    # to_state_dict turns namedtuple opt states / dataclasses into plain
-    # nested dicts that msgpack can carry
-    blob = flax_ser.msgpack_serialize(flax_ser.to_state_dict(host))
+    multi = jax.process_count() > 1
+    if multi:
+        from jax.experimental import multihost_utils
+
+        def to_host(x):
+            if getattr(x, "is_fully_addressable", True):
+                return np.asarray(x)
+            return np.asarray(
+                multihost_utils.process_allgather(x, tiled=True)
+            )
+
+        host = jax.tree.map(to_host, fed)
+    else:
+        host = jax.tree.map(np.asarray, fed)
     path = checkpoint_path(directory, int(host.round))
-    # atomic publish: a crash mid-write must never leave a truncated
-    # round_NNNNN file for latest_checkpoint to pick up
-    tmp = path.with_suffix(path.suffix + ".tmp")
-    tmp.write_bytes(blob)
-    os.replace(tmp, path)
+    if not multi or jax.process_index() == 0:
+        directory.mkdir(parents=True, exist_ok=True)
+        # to_state_dict turns namedtuple opt states / dataclasses into
+        # plain nested dicts that msgpack can carry
+        blob = flax_ser.msgpack_serialize(flax_ser.to_state_dict(host))
+        # atomic publish: a crash mid-write must never leave a truncated
+        # round_NNNNN file for latest_checkpoint to pick up
+        tmp = path.with_suffix(path.suffix + ".tmp")
+        tmp.write_bytes(blob)
+        os.replace(tmp, path)
+    if multi:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(f"p2pfl-ckpt-{int(host.round)}")
     return path
 
 

@@ -95,9 +95,14 @@ class Scenario(Observable):
         )
         self.roles = [nc.role for nc in config.nodes]
         self.membership = Membership(n, config.protocol)
-        self.logger = MetricsLogger(config.log_dir, config.name,
+        # multi-host (jax.distributed) job: every process runs the same
+        # host trajectory (deterministic from config.seed), but only
+        # process 0 owns the log/status/profile artifacts
+        self._proc0 = jax.process_index() == 0
+        self.logger = MetricsLogger(config.log_dir if self._proc0 else None,
+                                    config.name,
                                     tensorboard=config.tensorboard,
-                                    wandb=config.wandb)
+                                    wandb=config.wandb and self._proc0)
         if self.logger.dir is not None:
             # topology render next to the metrics (controller.py:301 /
             # monitoring-map analog) — best-effort: a render/save
@@ -108,6 +113,16 @@ class Scenario(Observable):
                 draw_topology(self.topology,
                               self.logger.dir / "topology.png",
                               roles=self.roles)
+            except Exception:
+                pass
+            try:
+                # 3-D/geo topology export for the dashboard map
+                # (topologymanager.py:151-173 + 320-355)
+                import json as _json
+
+                (self.logger.dir / "topology_3d.json").write_text(
+                    _json.dumps(self.topology.to_3d(seed=config.seed))
+                )
             except Exception:
                 pass
         self.transport = MeshTransport(n)
@@ -158,6 +173,19 @@ class Scenario(Observable):
         self._plan_cache: dict[tuple, tuple] = {}
 
     # ------------------------------------------------------------------
+    def _node_host(self, x) -> np.ndarray:
+        """Node-sharded device array -> full host copy. On a multi-host
+        mesh the per-node leaves are only partially addressable here,
+        so they come back via an allgather; single-process is a plain
+        transfer."""
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            return np.asarray(
+                multihost_utils.process_allgather(x, tiled=True)
+            )
+        return np.asarray(x)
+
     def _choose_sparse(self) -> bool:
         """Pick the collective schedule for weight exchange.
 
@@ -287,6 +315,11 @@ class Scenario(Observable):
             None if trains_override is None else trains_override.tobytes(),
         )
         if key not in self._plan_cache:
+            # bounded LRU: a binding rotating vote cap mints a fresh
+            # trains vector per round per leader, which would grow the
+            # cache without limit over a long scenario
+            while len(self._plan_cache) >= 64:
+                self._plan_cache.pop(next(iter(self._plan_cache)))
             plan = make_round_plan(
                 self.topology, self.roles, self.config.federation, self.leader
             )
@@ -297,6 +330,8 @@ class Scenario(Observable):
                 tr.put_stacked(jnp.asarray(plan.adopt)),
                 tr.put_stacked(jnp.asarray(trains)),
             )
+        else:
+            self._plan_cache[key] = self._plan_cache.pop(key)  # LRU touch
         return self._plan_cache[key]
 
     def _publish_statuses(self, r: int, alive: np.ndarray,
@@ -326,9 +361,9 @@ class Scenario(Observable):
 
     def evaluate(self) -> dict[str, Any]:
         metrics = self._eval_fn(self.fed, self._x_test, self._y_test)
-        acc = np.asarray(metrics["accuracy"], np.float64)
-        loss = np.asarray(metrics["loss"], np.float64)
-        alive = np.asarray(self.fed.alive)
+        acc = self._node_host(metrics["accuracy"]).astype(np.float64)
+        loss = self._node_host(metrics["loss"]).astype(np.float64)
+        alive = self._node_host(self.fed.alive)
         mean_acc = float(acc[alive].mean()) if alive.any() else 0.0
         return {
             "per_node_accuracy": [float(a) for a in acc],
@@ -351,7 +386,7 @@ class Scenario(Observable):
         # jax.profiler hook. try/finally: an exception mid-profiled-
         # round must not leave the tracer running.
         profile_round = None
-        if cfg.profile_dir:
+        if cfg.profile_dir and self._proc0:
             profile_round = start_round + (1 if rounds > 1 else 0)
         tracing = False
         try:
@@ -379,7 +414,8 @@ class Scenario(Observable):
                 round_times.append(dt)
                 self.global_step += self._steps_per_round
 
-                train_loss = np.asarray(metrics["train_loss"], np.float64)
+                train_loss = self._node_host(
+                    metrics["train_loss"]).astype(np.float64)
                 for i in range(cfg.n_nodes):
                     self.logger.log_metrics(
                         {"Train/loss": float(train_loss[i]),

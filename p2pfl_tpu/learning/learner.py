@@ -270,6 +270,30 @@ class NodeLearner:
     def finalize_round(self) -> None: raise NotImplementedError
 
 
+class SharedTrainer:
+    """One compiled trainer shared by many same-config learners.
+
+    An in-process simulation runs N ``JaxLearner``s whose models are
+    identical; letting each build its own ``make_step_fns`` closures
+    would compile N copies of the same XLA program (jit caches key on
+    the function object). Build one of these and pass it to every
+    ``JaxLearner(trainer=...)`` — one compile serves the federation.
+    """
+
+    def __init__(self, model, objective="classification", optimizer="sgd",
+                 learning_rate=0.1, momentum=0.9, weight_decay=0.0,
+                 batch_size=32):
+        self.fns = make_step_fns(
+            model, objective=objective, optimizer=optimizer,
+            learning_rate=learning_rate, momentum=momentum,
+            weight_decay=weight_decay, batch_size=batch_size,
+        )
+        self.train_jit = jax.jit(self.fns.train_epochs,
+                                 static_argnames=("epochs",))
+        self.eval_jit = jax.jit(self.fns.evaluate)
+        self.init_jit = jax.jit(self.fns.init)
+
+
 class JaxLearner(NodeLearner):
     """Single-node JAX learner (lightninglearner.py parity).
 
@@ -282,7 +306,8 @@ class JaxLearner(NodeLearner):
 
     def __init__(self, model=None, data=None, objective="classification",
                  optimizer="sgd", learning_rate=0.1, momentum=0.9,
-                 weight_decay=0.0, batch_size=32, seed=0, logger=None):
+                 weight_decay=0.0, batch_size=32, seed=0, logger=None,
+                 trainer: SharedTrainer | None = None):
         self.model = model
         self.data = data
         self.objective = objective
@@ -296,6 +321,7 @@ class JaxLearner(NodeLearner):
         self.epochs = 1
         self.state: TrainState | None = None
         self.fns: StepFns | None = None
+        self._shared = trainer
         self.global_step = 0
         self.local_step = 0
         self.round = 0
@@ -310,7 +336,14 @@ class JaxLearner(NodeLearner):
         self.data = data
 
     def create_trainer(self) -> None:
-        """Build + jit the step functions (Trainer-construction analog)."""
+        """Build + jit the step functions (Trainer-construction analog).
+        With a ``SharedTrainer`` the compiled programs are reused."""
+        if self._shared is not None:
+            self.fns = self._shared.fns
+            self._train_jit = self._shared.train_jit
+            self._eval_jit = self._shared.eval_jit
+            self._init_jit = self._shared.init_jit
+            return
         self.fns = make_step_fns(
             self.model, objective=self.objective,
             optimizer=self.optimizer_name, learning_rate=self.learning_rate,
@@ -320,13 +353,14 @@ class JaxLearner(NodeLearner):
         self._train_jit = jax.jit(self.fns.train_epochs,
                                   static_argnames=("epochs",))
         self._eval_jit = jax.jit(self.fns.evaluate)
+        self._init_jit = jax.jit(self.fns.init)
 
     def init(self) -> None:
         if self.fns is None:
             self.create_trainer()
         rng = jax.random.PRNGKey(self.seed)
         sample = jnp.asarray(self.data.x[:1])
-        self.state = jax.jit(self.fns.init)(rng, sample)
+        self.state = self._init_jit(rng, sample)
 
     # -- parameters ------------------------------------------------------
     def get_parameters(self):
@@ -369,21 +403,42 @@ class JaxLearner(NodeLearner):
         y = jnp.asarray(self.data.y)
         mask = jnp.ones(len(self.data.x), bool)
         t0 = time.monotonic()
-        self.state, metrics = self._train_jit(self.state, x, y, mask,
-                                              epochs=self.epochs)
-        steps = max(len(self.data.x) // self.batch_size, 1) * self.epochs
+        if self.epochs == 1:
+            self.state, metrics = self._train_jit(self.state, x, y, mask,
+                                                  epochs=1)
+            epochs_run = 1
+        else:
+            # multi-epoch fits run one compiled epoch at a time so
+            # interrupt_fit() takes effect at the next epoch boundary
+            # (the reference stops its Trainer mid-epoch via
+            # trainer.should_stop, lightninglearner.py:122-125; a
+            # jitted epoch is one device program and cannot be cut,
+            # but a 10-epoch fit must not be uninterruptible)
+            metrics = None
+            epochs_run = 0
+            for _ in range(self.epochs):
+                if self._interrupted:
+                    self._interrupted = False
+                    break
+                self.state, metrics = self._train_jit(self.state, x, y,
+                                                      mask, epochs=1)
+                epochs_run += 1
+            if metrics is None:
+                return
+        steps = max(len(self.data.x) // self.batch_size, 1) * epochs_run
         self.local_step = steps
         if self.logger is not None:
             self.logger.log_metrics(
                 {"Train/loss": float(metrics["loss"]),
-                 "Train/epoch_time_s": (time.monotonic() - t0) / self.epochs},
+                 "Train/epoch_time_s": (time.monotonic() - t0) / epochs_run},
                 step=self.global_step + steps, round=self.round,
             )
 
     def interrupt_fit(self) -> None:
         """Best-effort stop (lightninglearner.py:122-125). A jitted
-        fit is a single device program, so interruption takes effect at
-        the next fit call."""
+        epoch is a single device program, so interruption takes effect
+        at the next epoch boundary of a multi-epoch fit (or the next
+        fit call for single-epoch fits)."""
         self._interrupted = True
 
     def evaluate(self):

@@ -88,6 +88,7 @@ async def _run_node(cfg: ScenarioConfig, idx: int, ports: list[int],
         federation=cfg.federation,
         seed=cfg.seed,
         tls=tls,
+        netem=cfg.network,
     )
     await node.start()
     topo = generate_topology(cfg.topology, n, **cfg.topology_kwargs)
@@ -169,6 +170,85 @@ def node_main(config_path: str, idx: int, ports: list[int],
     result = asyncio.run(_run_node(cfg, idx, ports, tls_dir=tls_dir,
                                    hosts=hosts, bind=bind))
     print("P2PFL_RESULT " + json.dumps(result), flush=True)
+
+
+async def _simulate(cfg: ScenarioConfig, timeout: float = 600) -> dict:
+    n = cfg.n_nodes
+    data = FederatedDataset.make(cfg.data, n)
+    topo = generate_topology(cfg.topology, n, **cfg.topology_kwargs)
+    from p2pfl_tpu.learning.learner import SharedTrainer
+
+    shared = SharedTrainer(
+        build_model(cfg.model), objective=cfg.model.objective,
+        optimizer=cfg.training.optimizer,
+        learning_rate=cfg.training.learning_rate,
+        momentum=cfg.training.momentum,
+        weight_decay=cfg.training.weight_decay,
+        batch_size=cfg.data.batch_size,
+    )
+    nodes = [
+        P2PNode(
+            i,
+            JaxLearner(model=None, data=data.nodes[i],
+                       batch_size=cfg.data.batch_size, seed=cfg.seed,
+                       trainer=shared),
+            role=cfg.nodes[i].role,
+            n_nodes=n,
+            aggregator=get_aggregator(cfg.aggregator, **cfg.aggregator_kwargs),
+            protocol=cfg.protocol,
+            federation=cfg.federation,
+            seed=cfg.seed,
+            netem=cfg.network,
+        )
+        for i in range(n)
+    ]
+    for node in nodes:
+        await node.start()
+    for i in range(n):
+        for j in topo.neighbors(i):
+            if j > i:
+                await nodes[i].connect_to(nodes[j].host, nodes[j].port)
+    starter = next(
+        (i for i, nc in enumerate(cfg.nodes) if nc.start), 0
+    )
+    nodes[starter].learner.init()
+    t0 = time.monotonic()
+    nodes[starter].set_start_learning(
+        cfg.training.rounds, cfg.training.epochs_per_round
+    )
+    try:
+        await asyncio.wait_for(
+            asyncio.gather(*(nd.finished.wait() for nd in nodes)),
+            timeout=timeout,
+        )
+    finally:
+        wall = time.monotonic() - t0
+        for node in nodes:
+            await node.stop()
+    accs = [
+        m.get("accuracy") for m in
+        (nd.peer_metrics.get(nd.idx) or {} for nd in nodes)
+        if m.get("accuracy") is not None
+    ]
+    return {
+        "n_nodes": n,
+        "rounds": min(nd.round for nd in nodes),
+        "wall_s": round(wall, 3),
+        "round_s": round(wall / max(cfg.training.rounds, 1), 3),
+        "mean_accuracy": (
+            round(sum(accs) / len(accs), 4) if accs else None
+        ),
+    }
+
+
+def run_simulation(cfg: ScenarioConfig, timeout: float = 600) -> dict:
+    """ALL nodes of a socket federation in one process/event loop —
+    the reference's simulation mode (``scenario_args.simulation``,
+    SURVEY §4: same code path, loopback TCP, no cluster). One
+    ``SharedTrainer`` serves every node, so the model compiles once
+    instead of ``n_nodes`` times. Returns wall-clock and per-round
+    timing plus the federation's mean final accuracy."""
+    return asyncio.run(_simulate(cfg, timeout))
 
 
 def launch(cfg: ScenarioConfig, config_path: str | pathlib.Path,

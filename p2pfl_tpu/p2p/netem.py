@@ -1,0 +1,144 @@
+"""Deterministic network emulation for the socket path.
+
+The reference degrades links with ``tcset --rate/--delay/--loss`` read
+from config (fedstellar/base_node.py:82-85,
+config/participant.json.example:34-38) — kernel-level shaping that
+needs root and real interfaces. Here shaping happens at the message
+layer instead, deterministically (seeded), so a test can assert "an
+8-node federation converges under 50 ms delay + 5% loss" and get the
+same drops every run.
+
+Semantics per (src → dst) link:
+
+- **delay + jitter**: each message is due at ``now + delay ± U(0,
+  jitter)``; a per-link FIFO worker enforces ``due >= previous due``
+  so a link never reorders (TCP semantics — shaped latency, not UDP).
+- **loss**: the message is silently dropped before the socket write —
+  modeling a gossip datagram that never arrives. On this framework's
+  long-lived connections that is the application-level analog of
+  ``tcset --loss`` stalling a TCP stream past its usefulness window:
+  the receiver's timeouts (vote / aggregation / heartbeat eviction)
+  must carry the round, which is exactly what the knob exists to test.
+- **rate**: transmission time per message (payload bytes / rate) is
+  added to the link occupancy — the ``tcset --rate`` analog.
+- **backpressure**: link queues are bounded; a sender flooding a slow
+  link blocks on ``send`` like a full TCP send buffer would, instead
+  of growing an infinite buffer that starves every later message.
+
+Decisions come from one ``random.Random`` seeded per source node, so a
+given scenario seed yields one reproducible fault schedule per node
+regardless of event-loop interleaving across links.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+from typing import Awaitable, Callable
+
+from p2pfl_tpu.p2p.protocol import Message, write_message
+
+
+class LinkShaper:
+    """Per-source shaping of outbound messages (delay/jitter/loss)."""
+
+    #: bounded link queue — the "TCP send buffer". A sender that
+    #: outpaces the link blocks on send() when this fills.
+    QUEUE_DEPTH = 32
+
+    def __init__(
+        self,
+        src: int,
+        delay_ms: float = 0.0,
+        jitter_ms: float = 0.0,
+        loss_pct: float = 0.0,
+        rate_mbps: float = 0.0,
+        seed: int = 0,
+        on_error: Callable[[object], None] | None = None,
+    ):
+        self.src = src
+        self.delay_s = max(delay_ms, 0.0) / 1000.0
+        self.jitter_s = max(jitter_ms, 0.0) / 1000.0
+        self.loss = min(max(loss_pct, 0.0), 100.0) / 100.0
+        self.rate_bps = max(rate_mbps, 0.0) * 1e6 / 8.0  # bytes/s
+        self._rng = random.Random((seed, "netem", src).__repr__())
+        self._on_error = on_error
+        # per-destination FIFO: (peer, msg, due) consumed by one worker
+        self._queues: dict[int, asyncio.Queue] = {}
+        self._workers: dict[int, asyncio.Task] = {}
+        self._busy_until: dict[int, float] = {}
+        self._last_due: dict[int, float] = {}
+        self.sent = 0
+        self.dropped = 0
+
+    @property
+    def active(self) -> bool:
+        return (self.delay_s > 0 or self.jitter_s > 0 or self.loss > 0
+                or self.rate_bps > 0)
+
+    def _size(self, msg: Message) -> int:
+        return len(msg.payload or b"") + 256  # header/body estimate
+
+    async def send(self, peer, msg: Message) -> None:
+        """Queue ``msg`` for ``peer`` under the link schedule. Blocks
+        only when the link's bounded queue is full (backpressure);
+        delivery happens on the link worker."""
+        if self.loss and self._rng.random() < self.loss:
+            self.dropped += 1
+            return
+        loop = asyncio.get_event_loop()
+        now = loop.time()
+        # link occupancy: serialization time at the configured rate,
+        # FIFO behind whatever is already scheduled on this link
+        start = max(now, self._busy_until.get(peer.idx, 0.0))
+        tx = self._size(msg) / self.rate_bps if self.rate_bps else 0.0
+        self._busy_until[peer.idx] = start + tx
+        # one-way latency on top of serialization
+        due = start + tx + self.delay_s
+        if self.jitter_s:
+            due += self._rng.uniform(0.0, self.jitter_s)
+        # jitter must not reorder the link (TCP semantics)
+        due = max(due, self._last_due.get(peer.idx, 0.0))
+        self._last_due[peer.idx] = due
+        q = self._queues.get(peer.idx)
+        if q is None:
+            q = self._queues[peer.idx] = asyncio.Queue(self.QUEUE_DEPTH)
+            self._workers[peer.idx] = asyncio.create_task(self._drain(q))
+        await q.put((peer, msg, due))
+
+    async def _drain(self, q: asyncio.Queue) -> None:
+        loop = asyncio.get_event_loop()
+        while True:
+            peer, msg, due = await q.get()
+            wait = due - loop.time()
+            if wait > 0:
+                await asyncio.sleep(wait)
+            try:
+                await write_message(peer.writer, msg)
+                self.sent += 1
+            except (ConnectionError, RuntimeError, OSError):
+                if self._on_error is not None:
+                    self._on_error(peer)
+
+    def close(self) -> None:
+        for t in self._workers.values():
+            t.cancel()
+        self._workers.clear()
+        self._queues.clear()
+
+
+def shaper_from_config(src: int, net, on_error=None) -> LinkShaper | None:
+    """Build a shaper from a ``NetworkConfig`` (None or all-zero →
+    no shaping, zero-overhead direct writes)."""
+    if net is None:
+        return None
+    s = LinkShaper(
+        src,
+        delay_ms=net.delay_ms,
+        jitter_ms=net.jitter_ms,
+        loss_pct=net.loss_pct,
+        rate_mbps=getattr(net, "rate_mbps", 0.0),
+        seed=net.seed,
+        on_error=on_error,
+    )
+    return s if s.active else None

@@ -91,6 +91,7 @@ class P2PNode:
         federation: str = "DFL",
         seed: int = 0,
         tls=None,
+        netem=None,
     ):
         from p2pfl_tpu.p2p.session import AggregationSession
 
@@ -125,6 +126,12 @@ class P2PNode:
             self._signer = None
             self._verifier = None
         self._rng = random.Random(seed * 7919 + idx)
+        # deterministic link shaping (NetworkConfig / tcset analog,
+        # base_node.py:82-85) — None when unshaped, so the default
+        # send path stays a direct socket write
+        from p2pfl_tpu.p2p.netem import shaper_from_config
+
+        self.shaper = shaper_from_config(idx, netem, on_error=self._drop_conn)
         self.session = AggregationSession(
             aggregator, timeout_s=self.protocol.aggregation_timeout_s
         )
@@ -202,6 +209,8 @@ class P2PNode:
                 t.cancel()
                 with contextlib.suppress(asyncio.CancelledError):
                     await t
+        if self.shaper is not None:
+            self.shaper.close()  # in-flight shaped messages are lost
         for peer in list(self.peers.values()):
             if peer.reader_task:
                 peer.reader_task.cancel()
@@ -306,7 +315,7 @@ class P2PNode:
             # the flood can't echo back and be re-processed/re-forwarded
             self._sign(msg)
             self.dedup.check_and_add(msg.msg_id)
-            await write_message(peer.writer, msg)
+            await self._write(peer, msg)
 
         try:
             await send(Message(MsgType.ROLE, self.idx, {"role": self.role}))
@@ -368,7 +377,8 @@ class P2PNode:
             if not self._verify_origin(msg):
                 return  # forged: not processed, not forwarded, NOT seen
             self.dedup.check_and_add(msg.msg_id)
-            await self._forward(msg, exclude=peer.idx)
+            await self._forward(msg, exclude=peer.idx,
+                                limit=self.protocol.gossip_fanout)
         elif msg.type is MsgType.PARAMS and not self._verify_origin(msg):
             return
         t = msg.type
@@ -531,12 +541,30 @@ class P2PNode:
             self.dedup.check_and_add(msg.msg_id)
         await self._forward(msg, exclude)
 
-    async def _forward(self, msg: Message, exclude: int | None = None) -> None:
-        for peer in list(self.peers.values()):
-            if peer.idx == exclude:
-                continue
+    async def _write(self, peer: PeerState, msg: Message) -> None:
+        """Single egress point: a direct socket write, or the link
+        shaper's delayed/lossy schedule when network emulation is on.
+        Shaped sends never raise here — delivery errors surface on the
+        link worker, which drops the connection."""
+        if self.shaper is None:
+            await write_message(peer.writer, msg)
+        else:
+            await self.shaper.send(peer, msg)
+
+    async def _forward(self, msg: Message, exclude: int | None = None,
+                       limit: int = 0) -> None:
+        """Send to peers. ``limit`` > 0 relays to a random subset
+        instead (the GOSSIP_MESSAGES_PER_ROUND-style fan-out cap,
+        gossiper.py:66-112): on dense overlays every receiver
+        re-forwarding to ALL peers is O(peers^2) per flood; capped
+        epidemic relay with at-most-once dedup reaches everyone whp
+        in O(log n) hops at O(peers * fanout) traffic."""
+        targets = [p for p in self.peers.values() if p.idx != exclude]
+        if limit > 0 and len(targets) > limit:
+            targets = self._rng.sample(targets, limit)
+        for peer in targets:
             try:
-                await write_message(peer.writer, msg)
+                await self._write(peer, msg)
             except (ConnectionError, RuntimeError):
                 self._drop_conn(peer)
 
@@ -545,8 +573,8 @@ class P2PNode:
         body.setdefault("round", self.round)
         blob = encode_parameters(params, tuple(contributors), int(weight))
         try:
-            await write_message(
-                peer.writer,
+            await self._write(
+                peer,
                 self._sign(
                     Message(MsgType.PARAMS, self.idx, body, payload=blob,
                             # explicit id: PARAMS is a direct message,
@@ -664,8 +692,12 @@ class P2PNode:
         elects the ``train_set_size`` best-vouched-for candidates with
         index tie-break, so every node computes the same winners from
         the same ballots. Dead voters (evicted by membership) are
-        dropped from the tally; missing ballots stop blocking after
-        ``vote_timeout_s``.
+        dropped from the tally. If the ballot flood does NOT complete
+        within ``vote_timeout_s``, the tally would depend on which
+        ballots arrived where — so the election falls back to a
+        deterministic ballot-independent function of the local alive
+        view instead (identical winners whenever membership views
+        agree, which heartbeats converge far faster than vote floods).
         """
         loop = asyncio.get_event_loop()
         alive = set(self.membership.get_nodes())
@@ -679,16 +711,33 @@ class P2PNode:
                     {"round": self.round, "candidates": ballot})
         )
         deadline = loop.time() + self.protocol.vote_timeout_s
+        complete = False
         while loop.time() < deadline:
             alive = set(self.membership.get_nodes())
             if alive <= set(votes):
-                break  # every live node's ballot arrived
+                complete = True  # every live node's ballot arrived
+                break
             await asyncio.sleep(self.gossip_period_s)
-        tally: dict[int, int] = {}
-        for voter, cands in votes.items():
-            if voter in alive:  # dead voters dropped (node.py:537-548)
-                for c in cands:
-                    tally[c] = tally.get(c, 0) + 1
+        if not complete:
+            # Deterministic incomplete-ballot path: a partial tally
+            # depends on WHICH ballots happened to arrive here before
+            # the timeout, so two slow-gossip nodes could elect
+            # different train sets and their aggregation sessions
+            # would only close by timeout. Fall back to a
+            # ballot-independent election over the trainable alive
+            # MEMBERSHIP view (beats flood, so it spans multi-hop
+            # overlays — restricting to direct peers would diverge on
+            # a ring); nodes that share a membership view (heartbeats
+            # converge much faster than a vote flood) agree again.
+            alive = set(self.membership.get_nodes())
+            cands = self._trainable(alive)
+            tally = {c: 1 for c in cands}
+        else:
+            tally = {}
+            for voter, cands in votes.items():
+                if voter in alive:  # dead voters dropped (node.py:537-548)
+                    for c in cands:
+                        tally[c] = tally.get(c, 0) + 1
         k = self.protocol.train_set_size
         if k <= 0 or k > len(tally):
             k = len(tally)
@@ -743,13 +792,24 @@ class P2PNode:
 
     async def _diffuse_initial(self) -> None:
         params = self.learner.get_parameters()
-        deadline = asyncio.get_event_loop().time() + self.protocol.aggregation_timeout_s
+        loop = asyncio.get_event_loop()
+        deadline = loop.time() + self.protocol.aggregation_timeout_s
+        # re-send pacing: a resend before the previous copy could even
+        # arrive and be acknowledged (via the MODEL_INITIALIZED flood)
+        # just convoys megabytes behind itself — especially under
+        # shaped/delayed links. The reference paces diffusion at
+        # GOSSIP_MODELS_FREC = 1 Hz for the same reason.
+        retry_s = max(self.gossip_period_s * 4, 0.5)
+        last_sent: dict[int, float] = {}
         while (
             any(not self._progress(i).initialized for i in self.peers)
-            and asyncio.get_event_loop().time() < deadline
+            and loop.time() < deadline
         ):
             for idx, peer in list(self.peers.items()):
-                if not self._progress(idx).initialized:
+                now = loop.time()
+                if (not self._progress(idx).initialized
+                        and now - last_sent.get(idx, -1e9) >= retry_s):
+                    last_sent[idx] = now
                     await self._send_params(peer, params, (), 1, init=True)
             await asyncio.sleep(self.gossip_period_s)
 
@@ -875,6 +935,7 @@ class P2PNode:
         last_status = None
         last_change_t = loop.time()
         deadline = loop.time() + self.session.timeout_s
+        self._gossip_sent: dict[int, tuple[frozenset, float]] = {}
         # who is expected to AGGREGATE this round: in CFL/SDFL only the
         # round's leader fuses models (trainers adopt its offer — they
         # will never show coverage themselves, so waiting on them would
@@ -905,10 +966,14 @@ class P2PNode:
             # Progress floods, so this covers nodes reachable only
             # through a PROXY — but only REACHABLE targets may consume
             # fanout slots (building a partial for an undeliverable
-            # node would waste both the aggregation and the slot).
+            # node would waste both the aggregation and the slot), and
+            # only LIVE ones: a crashed aggregator (heartbeat-evicted,
+            # no STOP) must stop consuming fanout slots and proxy
+            # bandwidth even while a proxy path to its address exists.
+            live = set(self.membership.get_nodes())
             targets = [
                 (i, self._aggregated_by(i))
-                for i in sorted(aggregators - {self.idx})
+                for i in sorted((aggregators - {self.idx}) & live)
                 if not (train_set <= self._aggregated_by(i))
                 and (i in self.peers or proxies)
             ]
@@ -916,9 +981,22 @@ class P2PNode:
                 break
             random.shuffle(targets)
             for i, has in targets[:fanout]:
+                # re-send pacing: the same partial to the same stale
+                # target is only repeated after a retry window (loss
+                # recovery) — its progress flood needs at least an RTT
+                # to reflect the last send, and blind per-tick resends
+                # of megabyte payloads convoy every other message on
+                # the link (see _diffuse_initial)
+                now = loop.time()
+                key = frozenset(has)
+                prev = self._gossip_sent.get(i)
+                if (prev is not None and prev[0] == key
+                        and now - prev[1] < max(self.gossip_period_s * 4, 0.5)):
+                    continue
                 partial = self.session.get_partial_aggregation(has)
                 if partial is None:
                     continue
+                self._gossip_sent[i] = (key, now)
                 params, contribs, weight = partial
                 if i in self.peers:
                     await self._send_params(

@@ -13,13 +13,26 @@ Topology of a job: each host runs one process with its local devices;
 *i* — data for node *i* is materialized ONLY on the process that owns
 that device (``jax.make_array_from_callback`` slices the host copy).
 
-Simulation recipe (no cluster needed — the 2-process test in
+Two entry points:
+
+- ``run_scenario(config_path)`` — the real mode: the FULL ``Scenario``
+  surface (any topology/federation/aggregator, train-set votes, fault
+  injection, checkpoint/resume, metrics + monitoring) over the global
+  mesh. ``MeshTransport`` detects the multi-process runtime and places
+  every array with ``make_array_from_callback``; per-node host reads
+  ride ``process_allgather``; process 0 owns logs and checkpoints.
+- ``run_federation(...)`` — the minimal hardcoded demo kept as a
+  smoke target (fully-connected DFL FedAvg, one jit, no scenario
+  machinery).
+
+Simulation recipe (no cluster needed — the 2-process tests in
 tests/test_dcn.py): run N processes on localhost, each with
 ``--xla_force_host_platform_device_count=K`` virtual CPU devices, all
 pointing at the same coordinator:
 
     python -m p2pfl_tpu.parallel.dcn --coordinator 127.0.0.1:9911 \
-        --num-processes 2 --process-id {0,1} --platform cpu --rounds 1
+        --num-processes 2 --process-id {0,1} --platform cpu \
+        [--config scenario.json | --rounds 1]
 """
 
 from __future__ import annotations
@@ -140,6 +153,43 @@ def run_federation(rounds: int = 1, dataset: str = "mnist",
     }
 
 
+def run_scenario(config_path: str) -> dict:
+    """The REAL DCN mode: drive a full ``Scenario`` — topology,
+    federation scheme, robust aggregators, train-set votes, fault
+    injection, checkpoint/resume, metrics/monitoring — over the global
+    multi-process mesh. ``jax.distributed`` must be initialized first;
+    every process calls this with the same scenario file and executes
+    the same SPMD round program (MeshTransport places each node's
+    shards only on the process that owns its device; process 0 owns
+    the log artifacts)."""
+    import jax
+    import numpy as np
+
+    from p2pfl_tpu.config.schema import ScenarioConfig
+    from p2pfl_tpu.federation.scenario import Scenario
+
+    cfg = ScenarioConfig.load(config_path)
+    scenario = Scenario(cfg)
+    result = scenario.run()
+    scenario.close()
+    return {
+        "process": jax.process_index(),
+        "n_processes": jax.process_count(),
+        "n_nodes": cfg.n_nodes,
+        "federation": cfg.federation,
+        "topology": cfg.topology,
+        "aggregator": cfg.aggregator,
+        "sparse_transport": scenario.sparse_transport,
+        "rounds": result.rounds_run,
+        "final_accuracy": round(float(result.final_accuracy), 4),
+        "min_accuracy": round(float(result.min_accuracy), 4),
+        "mean_round_s": round(
+            float(np.mean(result.round_times_s)), 4
+        ) if result.round_times_s else None,
+        "leader": scenario.leader,
+    }
+
+
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(prog="p2pfl_tpu.parallel.dcn")
     ap.add_argument("--coordinator", default="127.0.0.1:9911")
@@ -150,14 +200,21 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--rounds", type=int, default=1)
     ap.add_argument("--dataset", default="mnist")
     ap.add_argument("--model", default="mnist-mlp")
+    ap.add_argument("--config", default=None,
+                    help="ScenarioConfig JSON: run the FULL scenario "
+                         "surface over the global mesh instead of the "
+                         "minimal demo federation")
     args = ap.parse_args(argv)
     if args.platform:
         import jax
 
         jax.config.update("jax_platforms", args.platform)
     initialize(args.coordinator, args.num_processes, args.process_id)
-    result = run_federation(rounds=args.rounds, dataset=args.dataset,
-                            model_name=args.model)
+    if args.config:
+        result = run_scenario(args.config)
+    else:
+        result = run_federation(rounds=args.rounds, dataset=args.dataset,
+                                model_name=args.model)
     print("P2PFL_DCN_RESULT " + json.dumps(result), flush=True)
     return 0
 

@@ -136,21 +136,39 @@ class MeshTransport:
         self._stacked = stacked_sharding(self.mesh)
         self._replicated = replicated_sharding(self.mesh)
 
+    def _place(self, x, sharding):
+        """DCN-safe placement: in a multi-process (jax.distributed)
+        job, ``device_put`` cannot target non-addressable devices, so
+        each process fills only the shards it owns via
+        ``make_array_from_callback`` (the dcn.make_global recipe) —
+        straight from the HOST copy, never bouncing through a local
+        device first. Single-process keeps the direct put."""
+        if jax.process_count() > 1:
+            import numpy as np
+
+            arr = np.asarray(x)
+            return jax.make_array_from_callback(
+                arr.shape, sharding, lambda idx: arr[idx]
+            )
+        return jax.device_put(jnp.asarray(x), sharding)
+
     def put_stacked(self, tree):
         """Shard each leaf's leading node axis; replicate scalars and
         leaves that don't carry the node axis (e.g. FederatedState.round)."""
 
         def place(x):
-            x = jnp.asarray(x)
-            if x.ndim >= 1 and x.shape[0] == self.n_nodes:
-                return jax.device_put(x, self._stacked)
-            return jax.device_put(x, self._replicated)
+            shape = getattr(x, "shape", None)
+            if shape is None:
+                shape = jnp.asarray(x).shape
+            if len(shape) >= 1 and shape[0] == self.n_nodes:
+                return self._place(x, self._stacked)
+            return self._place(x, self._replicated)
 
         return jax.tree.map(place, tree)
 
     def put_replicated(self, tree):
         return jax.tree.map(
-            lambda x: jax.device_put(jnp.asarray(x), self._replicated), tree
+            lambda x: self._place(x, self._replicated), tree
         )
 
     def compile_round(self, round_fn: Callable):

@@ -63,6 +63,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="jax.profiler trace of one steady-state round")
     p.add_argument("--save-config", default=None,
                    help="write the effective scenario JSON here and exit")
+    p.add_argument("--platform", default=None,
+                   help="force a JAX platform (e.g. cpu) before any "
+                        "device use — for browser-launched or CI runs")
     return p
 
 
@@ -97,6 +100,10 @@ def config_from_args(args: argparse.Namespace) -> ScenarioConfig:
 def main(argv: list[str] | None = None) -> int:
     parser = build_parser()
     args = parser.parse_args(argv)
+    if args.platform:
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
     if args.tensorboard and not args.log_dir and not args.config:
         # surface the misconfiguration before any compute is spent —
         # the logger would otherwise silently no-op the flag

@@ -113,12 +113,71 @@ class Topology:
             "edges": [[int(i), int(j)] for i, j in zip(*np.nonzero(self.adjacency))],
         }
 
+    def to_3d(self, seed: int = 0, geo: "np.ndarray | None" = None) -> dict:
+        """3-D topology export (topologymanager.py:320-355): nodes on a
+        unit sphere (deterministic Fibonacci lattice — uniform without
+        randomness) plus optional geo coordinates, edges as index
+        pairs. Rendered by the dashboard or any three.js-style viewer."""
+        n = self.n
+        k = np.arange(n, dtype=np.float64) + 0.5
+        phi = np.arccos(1.0 - 2.0 * k / n)
+        theta = np.pi * (1.0 + 5.0**0.5) * k
+        xyz = np.stack(
+            [np.sin(phi) * np.cos(theta), np.sin(phi) * np.sin(theta),
+             np.cos(phi)],
+            axis=1,
+        )
+        out = {
+            "kind": self.kind,
+            "n": n,
+            "nodes": [
+                {"id": int(i), "x": round(float(x), 4),
+                 "y": round(float(y), 4), "z": round(float(z), 4)}
+                for i, (x, y, z) in enumerate(xyz)
+            ],
+            "edges": [
+                [int(i), int(j)]
+                for i, j in zip(*np.nonzero(self.adjacency)) if i < j
+            ],
+        }
+        if geo is None:
+            geo = geo_coordinates(n, seed=seed)
+        for node, (lat, lon) in zip(out["nodes"], geo):
+            node["lat"] = round(float(lat), 4)
+            node["lon"] = round(float(lon), 4)
+        return out
+
     @staticmethod
     def from_dict(d: dict) -> "Topology":
         a = np.zeros((d["n"], d["n"]), dtype=bool)
         for i, j in d["edges"]:
             a[i, j] = True
         return Topology(a, kind=d.get("kind", "custom"))
+
+
+#: named lat/lon boxes for random node placement — the reference drops
+#: participants into Spain or Switzerland for its monitoring map
+#: (topologymanager.py:151-173)
+GEO_BOUNDS = {
+    "spain": (36.0, 43.5, -9.0, 3.0),
+    "switzerland": (45.9, 47.8, 6.0, 10.5),
+}
+
+
+def geo_coordinates(n: int, seed: int = 0,
+                    region: str = "spain") -> np.ndarray:
+    """Random-but-deterministic per-node geo coordinates ``[n, 2]``
+    (lat, lon) inside a named region (topologymanager.py:151-173's
+    random Spain/Switzerland coordinates, seeded for reproducibility)."""
+    if region not in GEO_BOUNDS:
+        raise ValueError(
+            f"unknown region {region!r}; have {sorted(GEO_BOUNDS)}"
+        )
+    lat0, lat1, lon0, lon1 = GEO_BOUNDS[region]
+    rng = np.random.default_rng(seed)
+    lat = rng.uniform(lat0, lat1, size=n)
+    lon = rng.uniform(lon0, lon1, size=n)
+    return np.stack([lat, lon], axis=1)
 
 
 def fully_connected(n: int) -> Topology:

@@ -9,10 +9,28 @@ reads the same on-disk artifacts the framework already writes
 
 - ``/``                    — scenario list (every run under the log root)
 - ``/scenario/<name>``     — live node table (auto-refreshing) + links
+- ``/designer``            — scenario designer form (deployment.html's
+                             role) that deploys through the run endpoint
 - ``/api/scenarios``       — JSON scenario index
 - ``/api/scenario/<name>`` — JSON node statuses (the monitoring feed)
 - ``/api/metrics/<name>``  — JSON tail of the metrics stream
 - ``/logs/<name>/<file>``  — tail of a node's log file, rendered
+
+Write routes (token-authenticated — the reference gates these behind
+login/session auth, app.py:195-254; here every mutating request must
+carry the shared token as ``Authorization: Bearer <token>`` or an
+``X-Auth-Token`` header / ``token`` form field):
+
+- ``POST /api/scenario/run``          — deploy: accepts a ScenarioConfig
+  JSON body (or the designer's form), stamps it under the log root and
+  launches ``python -m p2pfl_tpu.run`` as a child process (the
+  deployment-run endpoint, app.py:602-691)
+- ``POST /api/scenario/<name>/stop``  — terminate a deployed run
+  (app.py:532-543)
+- ``POST /api/scenario/<name>/remove``— stop + delete its artifacts
+  (app.py:545-555)
+- ``POST /api/scenario/<name>/reload``— re-deploy from the scenario's
+  saved config (app.py:694-714)
 
 The filesystem IS the database: node upserts are the atomic
 ``node_*.status.json`` replaces (webserver/database.py:253-274's
@@ -20,7 +38,8 @@ role), so the dashboard needs no writer process and works for
 in-process scenarios, socket federations, and compose deployments
 sharing a log volume.
 
-Run: ``python -m p2pfl_tpu.webapp <log_root> [--port 8666]``
+Run: ``python -m p2pfl_tpu.webapp <log_root> [--port 8666] [--token T]``
+(no ``--token`` mints one and prints it at startup).
 """
 
 from __future__ import annotations
@@ -29,10 +48,13 @@ import argparse
 import html
 import json
 import pathlib
+import secrets
+import shutil
+import subprocess
 import sys
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from urllib.parse import unquote
+from urllib.parse import parse_qs, unquote
 
 from p2pfl_tpu.utils.monitor import (
     DEFAULT_LIVENESS_S,
@@ -113,8 +135,59 @@ def tail_metrics(root: pathlib.Path, name: str, n: int = 200) -> list[dict]:
     return out
 
 
+class Deployments:
+    """Child processes launched through the run endpoint, by scenario
+    name (the Controller-in-process role, app.py:679-681 — here a
+    subprocess so a crashing scenario cannot take the dashboard down)."""
+
+    def __init__(self):
+        import threading
+
+        self.procs: dict[str, subprocess.Popen] = {}
+        # ThreadingHTTPServer handles requests concurrently: without
+        # the lock a double-submitted deploy passes the poll() check
+        # twice and orphans the first child
+        self._lock = threading.Lock()
+
+    def launch(self, name: str, config_path: pathlib.Path,
+               scenario_dir: pathlib.Path, platform: str | None) -> int:
+        with self._lock:
+            old = self.procs.get(name)
+            if old is not None and old.poll() is None:
+                raise RuntimeError(f"scenario {name!r} is already running")
+            cmd = [sys.executable, "-m", "p2pfl_tpu.run", str(config_path)]
+            if platform:
+                cmd += ["--platform", platform]
+            out = open(scenario_dir / "run.log", "ab")
+            proc = subprocess.Popen(cmd, stdout=out,
+                                    stderr=subprocess.STDOUT)
+            out.close()  # the child holds its own fd
+            self.procs[name] = proc
+            return proc.pid
+
+    def stop(self, name: str) -> bool:
+        proc = self.procs.get(name)
+        if proc is None or proc.poll() is not None:
+            return False
+        proc.terminate()
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait(timeout=10)
+        return True
+
+    def state(self, name: str) -> str | None:
+        proc = self.procs.get(name)
+        if proc is None:
+            return None
+        return "running" if proc.poll() is None else f"exited({proc.poll()})"
+
+
 class DashboardHandler(BaseHTTPRequestHandler):
     root: pathlib.Path  # set by make_server
+    token: str | None = None  # write-route auth; None disables writes
+    deployments: Deployments  # set by make_server
 
     def log_message(self, *args) -> None:  # quiet
         pass
@@ -152,6 +225,152 @@ class DashboardHandler(BaseHTTPRequestHandler):
             self._send(_page("error", f"<pre>{html.escape(str(e))}</pre>"),
                        code=500)
 
+    # ---- write surface ---------------------------------------------------
+
+    def _read_body(self) -> bytes:
+        length = int(self.headers.get("Content-Length") or 0)
+        return self.rfile.read(min(length, 1 << 20)) if length else b""
+
+    def _authorized(self, form: dict | None = None) -> bool:
+        """Shared-token check on every mutating route (the reference
+        gates writes behind session auth, app.py:195-254). Constant-
+        time compare; a server started without a token refuses writes
+        outright rather than running them open."""
+        if self.token is None:
+            return False
+        auth = self.headers.get("Authorization") or ""
+        candidates = [
+            auth[7:] if auth.startswith("Bearer ") else auth,
+            self.headers.get("X-Auth-Token") or "",
+        ]
+        if form:
+            candidates.extend(form.get("token", []))
+        return any(
+            c and secrets.compare_digest(c, self.token) for c in candidates
+        )
+
+    def do_POST(self) -> None:  # noqa: N802 (http.server API)
+        parts = [unquote(p) for p in self.path.split("?")[0].split("/") if p]
+        try:
+            body = self._read_body()
+            ctype = (self.headers.get("Content-Type") or "").split(";")[0]
+            # urllib and curl default the content type to urlencoded even
+            # for JSON bodies — sniff the payload, don't trust the header
+            looks_json = body.lstrip()[:1] in (b"{", b"[")
+            form = (
+                parse_qs(body.decode("utf-8", errors="replace"))
+                if ctype == "application/x-www-form-urlencoded"
+                and body and not looks_json else None
+            )
+            if not self._authorized(form):
+                return self._json_code(
+                    {"error": "missing or bad auth token"}, 401
+                )
+            if parts == ["api", "scenario", "run"] or parts == [
+                "scenario", "deployment", "run"
+            ]:
+                return self._run_scenario(body, form)
+            if len(parts) == 4 and parts[:2] == ["api", "scenario"]:
+                name, action = parts[2], parts[3]
+                if self._safe_child(name) is None:
+                    return self._json_code({"error": "bad scenario name"}, 400)
+                if action == "stop":
+                    stopped = self.deployments.stop(name)
+                    return self._json({"name": name, "stopped": stopped})
+                if action == "remove":
+                    self.deployments.stop(name)
+                    target = self._safe_child(name)
+                    if target is not None and target.is_dir():
+                        shutil.rmtree(target)
+                        return self._json({"name": name, "removed": True})
+                    return self._json({"name": name, "removed": False})
+                if action == "reload":
+                    return self._reload_scenario(name, form)
+            self._send(_page("not found", "<p>404</p>"), code=404)
+        except BrokenPipeError:
+            pass
+        except Exception as e:
+            self._json_code({"error": str(e)}, 500)
+
+    def _json_code(self, obj, code: int) -> None:
+        self._send(json.dumps(obj).encode(), "application/json", code=code)
+
+    def _config_from_request(self, body: bytes, form: dict | None):
+        """ScenarioConfig from a JSON body (automation) or the designer
+        form (app.py:649-673 builds participant JSONs the same way)."""
+        from p2pfl_tpu.config.schema import (
+            DataConfig,
+            ModelConfig,
+            ScenarioConfig,
+            TrainingConfig,
+        )
+
+        if form is None:
+            return ScenarioConfig.from_dict(json.loads(body.decode()))
+
+        def one(key, default=None):
+            vals = form.get(key)
+            return vals[0] if vals else default
+
+        return ScenarioConfig(
+            name=one("name", "scenario"),
+            federation=one("federation", "DFL"),
+            topology=one("topology", "fully"),
+            n_nodes=int(one("nodes", 2)),
+            data=DataConfig(
+                dataset=one("dataset", "mnist"),
+                partition=one("partition", "iid"),
+                samples_per_node=(
+                    int(one("samples_per_node"))
+                    if one("samples_per_node") else None
+                ),
+            ),
+            model=ModelConfig(model=one("model", "mnist-mlp")),
+            training=TrainingConfig(
+                rounds=int(one("rounds", 3)),
+                epochs_per_round=int(one("epochs", 1)),
+                learning_rate=float(one("lr", 0.1)),
+            ),
+            aggregator=one("aggregator", "fedavg"),
+        )
+
+    def _run_scenario(self, body: bytes, form: dict | None) -> None:
+        cfg = self._config_from_request(body, form)
+        if self._safe_child(cfg.name) is None:
+            return self._json_code({"error": "bad scenario name"}, 400)
+        scenario_dir = self.root / cfg.name
+        scenario_dir.mkdir(parents=True, exist_ok=True)
+        # the child logs into the dashboard's own root, so this page
+        # monitors what it launched (controller stamping, app.py:649-673)
+        cfg.log_dir = str(self.root)
+        config_path = scenario_dir / "scenario.json"
+        cfg.save(config_path)
+        platform = None
+        if form and form.get("platform"):
+            platform = form["platform"][0]
+        elif form is None:
+            platform = (self.headers.get("X-Platform") or None)
+        pid = self.deployments.launch(cfg.name, config_path, scenario_dir,
+                                      platform)
+        if form is not None:  # designer: bounce to the live page
+            self.send_response(303)
+            self.send_header("Location", f"/scenario/{cfg.name}")
+            self.end_headers()
+            return
+        self._json({"name": cfg.name, "pid": pid, "started": True})
+
+    def _reload_scenario(self, name: str, form: dict | None) -> None:
+        """Re-deploy from the saved config (app.py:694-714)."""
+        config_path = self._safe_child(name, "scenario.json")
+        if config_path is None or not config_path.is_file():
+            return self._json_code({"error": "no saved config"}, 404)
+        scenario_dir = config_path.parent
+        platform = form["platform"][0] if form and form.get("platform") \
+            else (self.headers.get("X-Platform") or None)
+        pid = self.deployments.launch(name, config_path, scenario_dir,
+                                      platform)
+        self._json({"name": name, "pid": pid, "started": True})
+
     def _route(self, parts: list[str]) -> None:
         if not parts:
             return self._index()
@@ -167,6 +386,13 @@ class DashboardHandler(BaseHTTPRequestHandler):
                 if self._safe_child(parts[2]) is None:
                     return self._json([])
                 return self._json(tail_metrics(self.root, parts[2]))
+            if len(parts) == 3 and parts[1] == "topology3d":
+                path = self._safe_child(parts[2], "topology_3d.json")
+                if path is not None and path.is_file():
+                    return self._send(path.read_bytes(), "application/json")
+                return self._json({})
+        if parts == ["designer"]:
+            return self._designer()
         if len(parts) == 2 and parts[0] == "scenario":
             return self._scenario(parts[1])
         if len(parts) == 2 and parts[0] == "topology":
@@ -180,18 +406,51 @@ class DashboardHandler(BaseHTTPRequestHandler):
     def _index(self) -> None:
         rows = "".join(
             "<tr><td><a href='/scenario/{n}'>{n}</a></td><td>{c}</td>"
-            "<td>{r}</td><td>{m}</td></tr>".format(
+            "<td>{r}</td><td>{d}</td><td>{m}</td></tr>".format(
                 n=html.escape(s["name"]), c=s["n_nodes"],
                 r="running" if s["running"] else "stopped",
+                d=html.escape(self.deployments.state(s["name"]) or "-"),
                 m="yes" if s["has_metrics"] else "-",
             )
             for s in list_scenarios(self.root)
         )
         body = (
+            "<p><a href='/designer'>deploy a new scenario</a></p>"
             "<table><tr><th>SCENARIO</th><th>NODES</th><th>STATE</th>"
-            f"<th>METRICS</th></tr>{rows}</table>"
+            f"<th>DEPLOYMENT</th><th>METRICS</th></tr>{rows}</table>"
         )
         self._send(_page("p2pfl_tpu scenarios", body, refresh=5))
+
+    def _designer(self) -> None:
+        """Scenario designer (deployment.html's role) — POSTs to the
+        deployment-run endpoint with the shared token."""
+        def select(name, options):
+            opts = "".join(f"<option>{o}</option>" for o in options)
+            return f"<label>{name} <select name='{name}'>{opts}</select></label>"
+
+        body = (
+            "<form method='post' action='/scenario/deployment/run'>"
+            "<p><label>name <input name='name' value='web-run'></label> "
+            "<label>nodes <input name='nodes' value='2' size='3'></label> "
+            + select("federation", ["DFL", "CFL", "SDFL"])
+            + select("topology", ["fully", "ring", "random", "star"])
+            + "</p><p>"
+            + select("dataset", ["mnist", "femnist", "cifar10", "syscall",
+                                 "wadi"])
+            + "<label>model <input name='model' value='mnist-mlp'></label> "
+            + select("partition", ["iid", "sorted", "dirichlet"])
+            + select("aggregator", ["fedavg", "median", "trimmedmean",
+                                    "krum"])
+            + "</p><p>"
+            "<label>rounds <input name='rounds' value='3' size='3'></label> "
+            "<label>epochs <input name='epochs' value='1' size='3'></label> "
+            "<label>lr <input name='lr' value='0.1' size='5'></label> "
+            "<label>samples/node <input name='samples_per_node' value='256' "
+            "size='6'></label>"
+            "</p><p><label>auth token <input name='token' type='password'>"
+            "</label> <button>deploy</button></p></form>"
+        )
+        self._send(_page("scenario designer", body))
 
     def _scenario(self, name: str) -> None:
         safe = self._safe_child(name)
@@ -216,7 +475,51 @@ class DashboardHandler(BaseHTTPRequestHandler):
                 f"<p><img src='/topology/{html.escape(name)}' "
                 "alt='topology' style='max-width:480px'></p>"
             )
+        body += self._geo_map(safe, name)
         self._send(_page(f"scenario {html.escape(name)}", body, refresh=2))
+
+    def _geo_map(self, safe: pathlib.Path, name: str) -> str:
+        """Inline SVG geo map of the federation (the reference's
+        monitoring map, monitoring.html + topologymanager.py:151-173):
+        nodes at their lat/lon, edges as lines."""
+        path = safe / "topology_3d.json"
+        if not path.is_file():
+            return ""
+        try:
+            topo = json.loads(path.read_text())
+            nodes = topo.get("nodes", [])
+            if not nodes or "lat" not in nodes[0]:
+                return ""
+            lats = [n["lat"] for n in nodes]
+            lons = [n["lon"] for n in nodes]
+            la0, la1 = min(lats), max(lats)
+            lo0, lo1 = min(lons), max(lons)
+            w, h, pad = 420, 260, 20
+
+            def xy(node):
+                x = pad + (node["lon"] - lo0) / max(lo1 - lo0, 1e-9) * (w - 2 * pad)
+                y = h - pad - (node["lat"] - la0) / max(la1 - la0, 1e-9) * (h - 2 * pad)
+                return round(x, 1), round(y, 1)
+
+            pts = [xy(n) for n in nodes]
+            lines = "".join(
+                f"<line x1='{pts[i][0]}' y1='{pts[i][1]}' "
+                f"x2='{pts[j][0]}' y2='{pts[j][1]}' stroke='#345'/>"
+                for i, j in topo.get("edges", [])
+            )
+            dots = "".join(
+                f"<circle cx='{x}' cy='{y}' r='4' fill='#7cf'>"
+                f"<title>node {n['id']} ({n['lat']}, {n['lon']})</title>"
+                f"</circle>"
+                for (x, y), n in zip(pts, nodes)
+            )
+            return (
+                f"<p>geo map (<a href='/api/topology3d/{html.escape(name)}'>"
+                f"3-D json</a>):</p><svg width='{w}' height='{h}' "
+                f"style='background:#181c20'>{lines}{dots}</svg>"
+            )
+        except Exception:
+            return ""
 
     def _logfile(self, name: str, fname: str) -> None:
         path = self._safe_child(name, "logs", fname)
@@ -232,10 +535,15 @@ class DashboardHandler(BaseHTTPRequestHandler):
 
 
 def make_server(log_root: str | pathlib.Path, port: int = 8666,
-                host: str = "127.0.0.1") -> ThreadingHTTPServer:
+                host: str = "127.0.0.1",
+                token: str | None = None) -> ThreadingHTTPServer:
+    """``token`` enables the write routes (deploy/stop/remove/reload);
+    ``None`` leaves the dashboard read-only."""
+    root = pathlib.Path(log_root)
+    root.mkdir(parents=True, exist_ok=True)
     handler = type(
         "BoundHandler", (DashboardHandler,),
-        {"root": pathlib.Path(log_root)},
+        {"root": root, "token": token, "deployments": Deployments()},
     )
     return ThreadingHTTPServer((host, port), handler)
 
@@ -245,9 +553,17 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("log_root", help="the scenarios' log_dir root")
     ap.add_argument("--port", type=int, default=8666)
     ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--token", default=None,
+                    help="shared auth token for the write routes; "
+                         "omitted = a fresh one is minted and printed")
+    ap.add_argument("--read-only", action="store_true",
+                    help="disable the write routes entirely")
     args = ap.parse_args(argv)
-    server = make_server(args.log_root, args.port, args.host)
+    token = None if args.read_only else (args.token or secrets.token_urlsafe(24))
+    server = make_server(args.log_root, args.port, args.host, token=token)
     print(f"dashboard on http://{args.host}:{server.server_address[1]}/")
+    if token is not None and not args.token:
+        print(f"write-route auth token: {token}")
     try:
         server.serve_forever()
     except KeyboardInterrupt:

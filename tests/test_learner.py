@@ -70,3 +70,53 @@ def test_num_samples(learner):
     n_train, n_val = learner.get_num_samples()
     assert n_train == len(learner.data.x)
     assert n_val == len(learner.data.x_val)
+
+
+def test_interrupt_fit_between_epochs():
+    """A multi-epoch fit stops at the next epoch boundary after
+    interrupt_fit() (the reference stops its Trainer mid-epoch via
+    trainer.should_stop, lightninglearner.py:122-125)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from p2pfl_tpu.datasets import FederatedDataset
+    from p2pfl_tpu.config.schema import DataConfig
+    from p2pfl_tpu.learning import JaxLearner
+    from p2pfl_tpu.models import get_model
+
+    fed = FederatedDataset.make(
+        DataConfig(dataset="mnist", samples_per_node=96, batch_size=32), 1
+    )
+    ln = JaxLearner(model=get_model("mnist-mlp"), data=fed.nodes[0],
+                    learning_rate=0.05, batch_size=32)
+    ln.set_epochs(5)
+    ln.init()
+
+    # interrupt DURING fit: patch the jitted epoch to trigger the flag
+    # after the second epoch completes
+    calls = {"n": 0}
+    real = ln._train_jit
+
+    def wrapped(state, x, y, mask, epochs):
+        calls["n"] += 1
+        if calls["n"] == 2:
+            ln.interrupt_fit()
+        return real(state, x, y, mask, epochs=epochs)
+
+    ln._train_jit = wrapped
+    ln.fit()
+    assert calls["n"] == 2  # epochs 3-5 never ran
+    steps_per_epoch = max(96 * 9 // 10 // 32, 1)  # val split removes 10%
+    assert ln.local_step == steps_per_epoch * 2
+    assert int(np.asarray(ln.state.step)) == steps_per_epoch * 2
+
+    # a pending interrupt before fit() skips it entirely
+    ln.interrupt_fit()
+    before = int(np.asarray(ln.state.step))
+    ln._train_jit = real
+    ln.fit()
+    assert int(np.asarray(ln.state.step)) == before
+    # and the flag is consumed: the next fit runs (one epoch per call
+    # iteration x 5)
+    ln.fit()
+    assert int(np.asarray(ln.state.step)) == before + steps_per_epoch * 5

@@ -126,12 +126,12 @@ _PROTO = ProtocolConfig(heartbeat_period_s=0.2, aggregation_timeout_s=20.0,
 
 
 async def _run_federation(roles, rounds=2, start_node=0, proto=_PROTO,
-                          samples=150, timeout=120):
+                          samples=150, timeout=120, netem=None):
     n = len(roles)
     fed, learners = _make_learners(n, samples=samples)
     nodes = [
         P2PNode(i, learners[i], role=roles[i], n_nodes=n, protocol=proto,
-                gossip_period_s=0.02)
+                gossip_period_s=0.02, netem=netem)
         for i in range(n)
     ]
     for node in nodes:

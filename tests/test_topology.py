@@ -83,3 +83,41 @@ def test_directed_random_is_strongly_connected():
         t = random_topology(5, prob=0.25, symmetric=False, seed=seed)
         assert (t.adjacency.sum(axis=0) > 0).all(), "node with zero in-degree"
         assert (t.adjacency.sum(axis=1) > 0).all(), "node with zero out-degree"
+
+
+def test_geo_coordinates_and_3d_export():
+    """Geo/map + 3-D export parity (topologymanager.py:151-173,
+    320-355): deterministic coordinates inside the named bounds, sphere
+    layout, undirected edge list."""
+    import numpy as np
+
+    from p2pfl_tpu.topology.topology import (
+        GEO_BOUNDS,
+        generate_topology,
+        geo_coordinates,
+    )
+
+    g1 = geo_coordinates(6, seed=4)
+    g2 = geo_coordinates(6, seed=4)
+    np.testing.assert_array_equal(g1, g2)
+    la0, la1, lo0, lo1 = GEO_BOUNDS["spain"]
+    assert ((g1[:, 0] >= la0) & (g1[:, 0] <= la1)).all()
+    assert ((g1[:, 1] >= lo0) & (g1[:, 1] <= lo1)).all()
+    ch = geo_coordinates(4, seed=1, region="switzerland")
+    assert ((ch[:, 0] >= 45.9) & (ch[:, 0] <= 47.8)).all()
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError):
+        geo_coordinates(3, region="atlantis")
+
+    topo = generate_topology("ring", 6)
+    d = topo.to_3d(seed=4)
+    assert len(d["nodes"]) == 6
+    # sphere layout: unit-norm positions
+    for node in d["nodes"]:
+        r = (node["x"]**2 + node["y"]**2 + node["z"]**2) ** 0.5
+        assert abs(r - 1.0) < 1e-2
+        assert "lat" in node and "lon" in node
+    # undirected: each ring edge appears once, i < j
+    assert all(i < j for i, j in d["edges"])
+    assert len(d["edges"]) == 6

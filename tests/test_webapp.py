@@ -123,3 +123,162 @@ def test_traversal_refused(server):
     with pytest.raises(urllib.error.HTTPError) as e:
         _get(server + "/scenario/..%2F..")
     assert e.value.code == 404
+
+
+# ---- write surface: deploy / stop / remove / auth -----------------------
+
+
+def _post(url, data=b"", headers=None, method="POST"):
+    req = urllib.request.Request(url, data=data, method=method,
+                                 headers=headers or {})
+    try:
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return r.status, r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+@pytest.fixture()
+def write_server(tmp_path):
+    from p2pfl_tpu.webapp import make_server as ms
+
+    srv = ms(tmp_path / "www", port=0, token="sekrit")
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield f"http://127.0.0.1:{srv.server_address[1]}", tmp_path / "www"
+    srv.shutdown()
+
+
+def test_write_routes_require_token(write_server):
+    base, _root = write_server
+    cfg = {"name": "x", "n_nodes": 2}
+    code, body = _post(base + "/api/scenario/run", json.dumps(cfg).encode())
+    assert code == 401
+    code, _ = _post(base + "/api/scenario/run", json.dumps(cfg).encode(),
+                    headers={"Authorization": "Bearer wrong"})
+    assert code == 401
+    code, _ = _post(base + "/api/scenario/x/stop")
+    assert code == 401
+    # read-only server (no token) refuses even a correct-looking token
+    from p2pfl_tpu.webapp import make_server as ms
+
+    import pathlib as _p
+    ro = ms(_p.Path(str(_root)) / "ro", port=0, token=None)
+    t = threading.Thread(target=ro.serve_forever, daemon=True)
+    t.start()
+    code, _ = _post(
+        f"http://127.0.0.1:{ro.server_address[1]}/api/scenario/run",
+        json.dumps(cfg).encode(), headers={"Authorization": "Bearer sekrit"})
+    assert code == 401
+    ro.shutdown()
+
+
+def test_deploy_stop_remove_roundtrip(write_server):
+    """Browser-driven orchestration (app.py:602-691, 532-555): deploy a
+    tiny scenario through the API, watch it produce artifacts, stop it,
+    remove it."""
+    import time as _time
+
+    base, root = write_server
+    cfg = {
+        "name": "webdeploy",
+        "n_nodes": 2,
+        "topology": "fully",
+        "data": {"dataset": "mnist", "samples_per_node": 64},
+        "training": {"rounds": 1, "epochs_per_round": 1,
+                     "learning_rate": 0.1},
+    }
+    auth = {"Authorization": "Bearer sekrit", "X-Platform": "cpu"}
+    code, body = _post(base + "/api/scenario/run",
+                       json.dumps(cfg).encode(), headers=auth)
+    assert code == 200, body
+    out = json.loads(body)
+    assert out["started"] and out["name"] == "webdeploy"
+
+    # double-deploy while running is refused
+    code, body = _post(base + "/api/scenario/run",
+                       json.dumps(cfg).encode(), headers=auth)
+    assert code == 500 and "already running" in body
+
+    # the stamped config landed and the child eventually writes statuses
+    assert (root / "webdeploy" / "scenario.json").exists()
+    deadline = _time.monotonic() + 120
+    while _time.monotonic() < deadline:
+        if (root / "webdeploy" / "status").is_dir():
+            break
+        _time.sleep(0.5)
+    assert (root / "webdeploy" / "status").is_dir(), (
+        (root / "webdeploy" / "run.log").read_text()[-2000:]
+        if (root / "webdeploy" / "run.log").exists() else "no run.log"
+    )
+
+    # stop is idempotent-ish: after the child exits it reports False
+    code, body = _post(base + "/api/scenario/webdeploy/stop", headers=auth)
+    assert code == 200
+
+    # remove deletes the artifacts
+    code, body = _post(base + "/api/scenario/webdeploy/remove", headers=auth)
+    assert code == 200 and json.loads(body)["removed"]
+    assert not (root / "webdeploy").exists()
+
+    # reload after remove: no saved config -> 404
+    code, _ = _post(base + "/api/scenario/webdeploy/reload", headers=auth)
+    assert code == 404
+
+
+def test_designer_form_deploys(write_server):
+    base, root = write_server
+    from urllib.parse import urlencode
+
+    form = urlencode({
+        "name": "formrun", "nodes": "2", "federation": "DFL",
+        "topology": "fully", "dataset": "mnist", "model": "mnist-mlp",
+        "partition": "iid", "aggregator": "fedavg", "rounds": "1",
+        "epochs": "1", "lr": "0.1", "samples_per_node": "64",
+        "token": "sekrit", "platform": "cpu",
+    }).encode()
+    code, _ = _post(base + "/scenario/deployment/run", form,
+                    headers={"Content-Type":
+                             "application/x-www-form-urlencoded"})
+    # designer redirects to the live scenario page
+    assert code in (200, 303)
+    assert (root / "formrun" / "scenario.json").exists()
+    saved = json.loads((root / "formrun" / "scenario.json").read_text())
+    assert saved["n_nodes"] == 2 and saved["training"]["rounds"] == 1
+    _post(base + "/api/scenario/formrun/stop",
+          headers={"Authorization": "Bearer sekrit"})
+
+
+def test_designer_page_renders(write_server):
+    base, _root = write_server
+    status, body = _get(base + "/designer")
+    assert status == 200 and "deployment/run" in body and "token" in body
+
+
+def test_topology3d_endpoint_and_geo_map(tmp_path):
+    """Geo/3-D topology surface (topologymanager.py:151-173, 320-355):
+    the scenario page embeds the SVG map and /api/topology3d serves the
+    export."""
+    from p2pfl_tpu.topology.topology import generate_topology
+
+    publish_status(tmp_path / "geo" / "status", 0, {"role": "aggregator"})
+    topo = generate_topology("ring", 4)
+    (tmp_path / "geo" / "topology_3d.json").write_text(
+        json.dumps(topo.to_3d(seed=1))
+    )
+    srv = make_server(tmp_path, port=0)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    base = f"http://127.0.0.1:{srv.server_address[1]}"
+    try:
+        status, body = _get(base + "/api/topology3d/geo")
+        assert status == 200
+        d = json.loads(body)
+        assert len(d["nodes"]) == 4 and "lat" in d["nodes"][0]
+        status, page = _get(base + "/scenario/geo")
+        assert status == 200 and "<svg" in page and "geo map" in page
+        # absent export -> empty JSON, page still renders without map
+        status, body = _get(base + "/api/topology3d/nosuch")
+        assert status == 200 and json.loads(body) == {}
+    finally:
+        srv.shutdown()

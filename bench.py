@@ -410,48 +410,68 @@ def _cifar16() -> dict:
         return {"cifar16_dirichlet_round_s": None}
 
 
+def _vit32_inprocess(use_flash: bool) -> dict:
+    """The vit32 measurement body — run this in a FRESH process (see
+    ``_vit32``): the Pallas flash kernels reliably fault the TPU worker
+    when launched after other configs' allocations (observed twice:
+    standalone runs succeed, post-cifar runs crash the worker and take
+    the whole process's backend with them)."""
+    from p2pfl_tpu.core.aggregators import Krum
+
+    run = _build(32, dataset="cifar10", model="vit-tiny",
+                 topology="fully", aggregator=Krum(f=1, m=3),
+                 partition="iid", samples_per_node=512,
+                 batch_size=115, learning_rate=1e-3,
+                 optimizer="adam", seed=4,
+                 model_kwargs={"use_flash": use_flash,
+                               "remat": True,
+                               "scan_layers": True})
+    round_s = _time_chained(run, k=5, reps=3)
+    _, _, final, accs = _accuracy_run(run, target=0.80, max_rounds=20,
+                                      measure_seconds=False)
+    return {
+        "vit32_krum_round_s": round(round_s, 4),
+        "vit32_krum_acc_20r": round(float(accs[19]), 4),
+        "vit32_krum_final_acc": round(final, 4),
+        "vit32_used_flash_attention": use_flash,
+        "vit32_synthetic_data": run["ds"].synthetic,
+    }
+
+
 def _vit32() -> dict:
     """BASELINE.json configs[4] (stretch): ViT-Tiny, 32 nodes, Krum
     aggregator, Pallas flash attention — the first on-TPU federation
-    exercising ops.flash under the robust-aggregation path."""
-    import gc
+    exercising ops.flash under the robust-aggregation path.
+
+    Each attempt gets a FRESH subprocess with exclusive first claim on
+    the chip (main() runs this before touching the TPU itself): a
+    kernel fault kills only the child, and the XLA-attention fallback
+    retries in another clean process."""
+    import json as _json
+    import subprocess
     import sys
 
-    import jax
-
-    from p2pfl_tpu.core.aggregators import Krum
-
-    # release every earlier config's compiled programs + buffers: the
-    # Pallas flash kernels are sensitive to a fragmented HBM (observed:
-    # a run that succeeds on a fresh process can fault the TPU worker
-    # after the cifar16 config's allocations)
-    jax.clear_caches()
-    gc.collect()
+    repo = str(__import__("pathlib").Path(__file__).resolve().parent)
     for use_flash in (True, False):
+        code = (
+            f"import sys; sys.path.insert(0, {repo!r})\n"
+            "import json, bench\n"
+            f"out = bench._vit32_inprocess({use_flash!r})\n"
+            "print('BENCH_VIT32 ' + json.dumps(out))\n"
+        )
         try:
-            run = _build(32, dataset="cifar10", model="vit-tiny",
-                         topology="fully", aggregator=Krum(f=1, m=3),
-                         partition="iid", samples_per_node=512,
-                         batch_size=115, learning_rate=1e-3,
-                         optimizer="adam", seed=4,
-                         model_kwargs={"use_flash": use_flash,
-                                       "remat": True,
-                                       "scan_layers": True})
-            round_s = _time_chained(run, k=5, reps=3)
-            _, _, final, accs = _accuracy_run(run, target=0.80,
-                                              max_rounds=20,
-                                              measure_seconds=False)
-            return {
-                "vit32_krum_round_s": round(round_s, 4),
-                "vit32_krum_acc_20r": round(float(accs[19]), 4),
-                "vit32_krum_final_acc": round(final, 4),
-                "vit32_used_flash_attention": use_flash,
-                "vit32_synthetic_data": run["ds"].synthetic,
-            }
-        except Exception as e:
-            print(f"vit32 (use_flash={use_flash}) failed: {e!r}",
+            res = subprocess.run([sys.executable, "-c", code],
+                                 capture_output=True, text=True,
+                                 timeout=1200)
+            for line in res.stdout.splitlines():
+                if line.startswith("BENCH_VIT32 "):
+                    return _json.loads(line[len("BENCH_VIT32 "):])
+            print(f"vit32 child (use_flash={use_flash}) rc="
+                  f"{res.returncode}: {res.stderr[-400:]}",
                   file=sys.stderr)
-            gc.collect()
+        except Exception as e:
+            print(f"vit32 child (use_flash={use_flash}) failed: {e!r}",
+                  file=sys.stderr)
     return {"vit32_krum_round_s": None}
 
 
@@ -506,6 +526,11 @@ print("BENCH_SOCK24 " + json.dumps(run_simulation(cfg, timeout=280)))
 
 
 def main() -> None:
+    # vit32 runs FIRST, in a subprocess, before this process touches
+    # the TPU: its Pallas kernels need a fresh chip (see _vit32), and
+    # a child kernel fault must not take the whole bench down
+    vit = _vit32()
+
     import jax
 
     # ---- headline: 64-node FEMNIST-CNN ring -------------------------
@@ -526,7 +551,6 @@ def main() -> None:
     round_s_8 = _time_rounds_synced(run8)
 
     cifar = _cifar16()
-    vit = _vit32()
     cpu8 = _sparse_vs_dense_cpu()
     sock24 = _socket24()
 

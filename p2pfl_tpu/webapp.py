@@ -210,7 +210,9 @@ class DashboardHandler(BaseHTTPRequestHandler):
         resolved path must still live under the root (symlink guard)."""
         for part in parts:
             if (not part or part in (".", "..")
-                    or "/" in part or "\\" in part or "\x00" in part):
+                    or "/" in part or "\\" in part
+                    or '"' in part
+                    or any(ord(c) < 0x20 or ord(c) == 0x7F for c in part)):
                 return None
         p = self.root.joinpath(*parts).resolve()
         return p if p.is_relative_to(self.root.resolve()) else None
@@ -391,6 +393,8 @@ class DashboardHandler(BaseHTTPRequestHandler):
                 if path is not None and path.is_file():
                     return self._send(path.read_bytes(), "application/json")
                 return self._json({})
+            if len(parts) == 3 and parts[1] == "download":
+                return self._download(parts[2])
         if parts == ["designer"]:
             return self._designer()
         if len(parts) == 2 and parts[0] == "scenario":
@@ -467,6 +471,7 @@ class DashboardHandler(BaseHTTPRequestHandler):
         body = (
             inner
             + f"<p><a href='/api/metrics/{html.escape(name)}'>metrics</a>"
+            + f" | <a href='/api/download/{html.escape(name)}'>download zip</a>"
             + (f" | logs: {links}" if links else "")
             + "</p>"
         )
@@ -520,6 +525,39 @@ class DashboardHandler(BaseHTTPRequestHandler):
             )
         except Exception:
             return ""
+
+    def _download(self, name: str) -> None:
+        """Zip the scenario's artifacts for offline analysis (the
+        metrics-zip download, webserver/app.py:586-594). Streams from
+        an in-memory archive of metrics/statuses/config/topology —
+        logs excluded (they can be huge; the log viewer tails them)."""
+        import io
+        import zipfile
+
+        safe = self._safe_child(name)
+        if safe is None or not safe.is_dir():
+            return self._send(_page("not found", "<p>404</p>"), code=404)
+        buf = io.BytesIO()
+        with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as z:
+            for rel in ("metrics.jsonl", "metrics.csv", "scenario.json",
+                        "topology.png", "topology_3d.json"):
+                p = safe / rel
+                if p.is_file():
+                    z.write(p, f"{name}/{rel}")
+            status_dir = safe / "status"
+            if status_dir.is_dir():
+                for p in sorted(status_dir.glob("*.json")):
+                    z.write(p, f"{name}/status/{p.name}")
+        body = buf.getvalue()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/zip")
+        # fixed filename: a header built from the (request-supplied)
+        # scenario name would be a response-splitting vector
+        self.send_header("Content-Disposition",
+                         'attachment; filename="metrics.zip"')
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
 
     def _logfile(self, name: str, fname: str) -> None:
         path = self._safe_child(name, "logs", fname)

@@ -129,3 +129,36 @@ def test_two_process_dcn_full_scenario(tmp_path):
     assert (tmp_path / "logs" / "dcn-sdfl" / "metrics.jsonl").exists()
     ckpts = sorted((tmp_path / "ckpt").glob("round_*.ckpt.msgpack"))
     assert len(ckpts) == 2, ckpts
+
+    # ---- multi-host RESUME: a fresh 2-process job restores the
+    # round-2 checkpoint (gathered+written by proc 0, loaded by both)
+    # and continues for another 2 rounds with the replayed SDFL
+    # leadership trajectory
+    port2 = _free_port()
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-m", "p2pfl_tpu.parallel.dcn",
+             "--coordinator", f"127.0.0.1:{port2}",
+             "--num-processes", "2", "--process-id", str(i),
+             "--platform", "cpu", "--config", str(config_path)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for i in range(2)
+    ]
+    results2, outs2 = [], []
+    for p in procs:
+        out, _ = p.communicate(timeout=360)
+        outs2.append(out)
+        for line in out.splitlines():
+            if line.startswith("P2PFL_DCN_RESULT "):
+                results2.append(json.loads(line[len("P2PFL_DCN_RESULT "):]))
+    assert len(results2) == 2, (
+        f"missing resume results; outputs:\n{outs2[0]}\n{outs2[1]}"
+    )
+    assert results2[0]["leader"] == results2[1]["leader"]
+    rounds = sorted(
+        int(p.name.split("_")[1].split(".")[0])
+        for p in (tmp_path / "ckpt").glob("round_*.ckpt.msgpack")
+    )
+    assert rounds == [1, 2, 3, 4], rounds  # resumed past round 2

@@ -142,3 +142,35 @@ def test_compose_cli(tmp_path, capsys):
     out = capsys.readouterr().out
     assert "docker compose" in out
     assert (tmp_path / "out" / "docker-compose.yml").exists()
+
+
+def test_compose_includes_dashboard_service(tmp_path):
+    """With a log_dir the bundle carries the monitoring dashboard on a
+    shared log volume (the reference runs its webserver alongside the
+    federation, controller.py:159-182) and the stamped scenario points
+    nodes at the in-container volume path."""
+    import json
+
+    from p2pfl_tpu.deploy import cleanup, generate_compose
+
+    cfg = ScenarioConfig(
+        name="depdash", n_nodes=2,
+        data=DataConfig(dataset="mnist", samples_per_node=100),
+        log_dir=str(tmp_path / "host-logs"),
+    )
+    compose = generate_compose(cfg, tmp_path / "out")
+    text = compose.read_text()
+    assert "depdash-dashboard:" in text
+    assert "--read-only" in text
+    assert "scenario-logs:/app/logs" in text
+    stamped = json.loads((tmp_path / "out" / "scenario.json").read_text())
+    assert stamped["log_dir"] == "/app/logs"
+    cmds = cleanup(cfg, dry_run=True)
+    assert any("depdash-dashboard" in c for c in cmds)
+
+    # without log_dir: no dashboard, no volumes
+    cfg2 = ScenarioConfig(name="plain", n_nodes=2,
+                          data=DataConfig(dataset="mnist",
+                                          samples_per_node=100))
+    text2 = generate_compose(cfg2, tmp_path / "out2").read_text()
+    assert "dashboard" not in text2 and "volumes" not in text2

@@ -282,3 +282,22 @@ def test_topology3d_endpoint_and_geo_map(tmp_path):
         assert status == 200 and json.loads(body) == {}
     finally:
         srv.shutdown()
+
+
+def test_metrics_zip_download(server, tmp_path):
+    """Metrics zip export (webserver/app.py:586-594)."""
+    import io
+    import zipfile
+
+    with urllib.request.urlopen(server + "/api/download/alpha",
+                                timeout=10) as r:
+        assert r.status == 200
+        assert r.headers["Content-Type"] == "application/zip"
+        data = r.read()
+    z = zipfile.ZipFile(io.BytesIO(data))
+    names = z.namelist()
+    assert "alpha/metrics.jsonl" in names
+    assert any(n.startswith("alpha/status/") for n in names)
+    # traversal-safe + 404 on unknown
+    code, _ = _post(server + "/api/download/nosuch", method="GET")
+    assert code == 404

@@ -151,9 +151,21 @@ class Scenario(Observable):
                 epochs=config.training.epochs_per_round,
             )
         else:
+            # one shared robust aggregate when every aggregating row is
+            # identical (single-leader CFL/SDFL; fully-connected DFL):
+            # the per-row path is O(n) redundant aggregations there
+            adj = self.topology.adjacency
+            fully = bool(
+                np.all(adj | np.eye(n, dtype=bool))
+            )
+            shared = (
+                config.federation in ("CFL", "SDFL")
+                or (config.federation == "DFL" and fully)
+            )
             round_fn = build_round_fn(
                 self.fns, aggregator=self.aggregator,
                 epochs=config.training.epochs_per_round,
+                shared_aggregate=shared,
             )
         self._round_fn = tr.compile_round(round_fn)
         self._eval_fn = tr.compile_eval(build_eval_fn(self.fns))

@@ -31,6 +31,11 @@ import jax
 import jax.numpy as jnp
 
 
+#: lane width of the saved softmax stats (lse/delta): a full TPU lane
+#: tile, value replicated, instead of a degenerate lane-dim-1 layout
+_STATS_LANES = 128
+
+
 def reference_attention(q, k, v):
     """Plain softmax attention ([b, s, h, d] layout) — the fallback and
     the parity oracle."""
@@ -75,7 +80,13 @@ def _attn_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
     m, l, acc = jax.lax.fori_loop(0, sk // block_k, body, (m0, l0, a0))
     l = jnp.maximum(l, 1e-30)
     o_ref[:] = (acc / l).astype(o_ref.dtype)
-    lse_ref[:] = m + jnp.log(l)
+    # stats stored lane-REPLICATED across a full 128-lane tile: a
+    # lane-dim-1 layout lowers through Mosaic's degenerate-tile path,
+    # which intermittently faulted the TPU worker inside the federated
+    # ViT workload (vmap + remat + donation memory pressure); a natural
+    # (8, 128) tile costs 127 redundant f32 lanes per row and is
+    # robust. Readers slice [:, :1].
+    lse_ref[:] = jnp.broadcast_to(m + jnp.log(l), lse_ref.shape)
 
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
@@ -88,8 +99,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     sk = k_ref.shape[0]
     q = q_ref[:].astype(jnp.float32)
     do = do_ref[:].astype(jnp.float32)
-    lse = lse_ref[:]  # [bq, 1]
-    delta = delta_ref[:]  # [bq, 1]
+    lse = lse_ref[:, :1]  # lane-replicated tile -> [bq, 1]
+    delta = delta_ref[:, :1]
 
     def body(i, acc):
         k = k_ref[pl.ds(i * block_k, block_k), :].astype(jnp.float32)
@@ -120,8 +131,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         sl = pl.ds(i * block_q, block_q)
         q = q_ref[sl, :].astype(jnp.float32)
         do = do_ref[sl, :].astype(jnp.float32)
-        lse = lse_ref[sl, :]
-        delta = delta_ref[sl, :]
+        lse = lse_ref[sl, :1]  # lane-replicated -> [bq, 1]
+        delta = delta_ref[sl, :1]
         s = _dot(q, k, ((1,), (1,))) * scale  # [bq, bk]
         p = jnp.exp(s - lse)
         dv_acc = dv_acc + _dot(p, do, ((0,), (0,)))  # p^T @ do
@@ -211,16 +222,16 @@ def _flash_forward(q, k, v, block_q: int, block_k: int, interpret: bool):
         ],
         out_specs=(
             pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
-            # lane-dim-1 stats layout: verified to lower via Mosaic and
-            # run at parity with XLA on real TPU (v5e) — CI exercises
-            # only the interpreter, so if a future toolchain rejects
-            # this layout, switch lse/delta to [b*h, sq] with sq in the
-            # lane dimension (the upstream flash kernel's layout)
-            pl.BlockSpec((None, block_q, 1), lambda i, j: (i, j, 0)),
+            # stats ride a full 128-lane tile, value replicated across
+            # lanes (see _attn_kernel) — the earlier lane-dim-1 layout
+            # lowered but intermittently faulted the TPU worker under
+            # the federated ViT's memory pressure
+            pl.BlockSpec((None, block_q, _STATS_LANES),
+                         lambda i, j: (i, j, 0)),
         ),
         out_shape=(
             jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
-            jax.ShapeDtypeStruct((b * h, sq, 1), jnp.float32),
+            jax.ShapeDtypeStruct((b * h, sq, _STATS_LANES), jnp.float32),
         ),
         interpret=interpret,
     )(qr, kr, vr)
@@ -236,18 +247,24 @@ def _flash_bwd(block_q, block_k, interpret, residuals, g):
     scale = 1.0 / (d**0.5)
     qr, kr, vr = _fold(q), _fold(k), _fold(v)
     dor = _fold(g)
-    # softmax-jacobian correction: delta_i = rowsum(dO_i * O_i)
-    delta = jnp.sum(
-        dor.astype(jnp.float32) * _fold(out).astype(jnp.float32),
-        axis=-1, keepdims=True,
+    # softmax-jacobian correction: delta_i = rowsum(dO_i * O_i),
+    # lane-replicated like the saved lse (see _attn_kernel)
+    delta = jnp.broadcast_to(
+        jnp.sum(
+            dor.astype(jnp.float32) * _fold(out).astype(jnp.float32),
+            axis=-1, keepdims=True,
+        ),
+        lse.shape,
     )
     qkv_specs = [
         pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),  # q blk
         pl.BlockSpec((None, sk, d), lambda i, j: (i, 0, 0)),  # k full
         pl.BlockSpec((None, sk, d), lambda i, j: (i, 0, 0)),  # v full
         pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),  # do blk
-        pl.BlockSpec((None, block_q, 1), lambda i, j: (i, j, 0)),  # lse blk
-        pl.BlockSpec((None, block_q, 1), lambda i, j: (i, j, 0)),  # delta
+        pl.BlockSpec((None, block_q, _STATS_LANES),
+                     lambda i, j: (i, j, 0)),  # lse blk
+        pl.BlockSpec((None, block_q, _STATS_LANES),
+                     lambda i, j: (i, j, 0)),  # delta
     ]
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, block_k=block_k, scale=scale),
@@ -263,8 +280,10 @@ def _flash_bwd(block_q, block_k, interpret, residuals, g):
         pl.BlockSpec((None, block_k, d), lambda i, j: (i, j, 0)),  # k blk
         pl.BlockSpec((None, block_k, d), lambda i, j: (i, j, 0)),  # v blk
         pl.BlockSpec((None, sq, d), lambda i, j: (i, 0, 0)),  # do full
-        pl.BlockSpec((None, sq, 1), lambda i, j: (i, 0, 0)),  # lse full
-        pl.BlockSpec((None, sq, 1), lambda i, j: (i, 0, 0)),  # delta full
+        pl.BlockSpec((None, sq, _STATS_LANES),
+                     lambda i, j: (i, 0, 0)),  # lse full
+        pl.BlockSpec((None, sq, _STATS_LANES),
+                     lambda i, j: (i, 0, 0)),  # delta full
     ]
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, block_q=block_q, scale=scale),

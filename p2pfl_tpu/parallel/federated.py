@@ -162,6 +162,7 @@ def build_round_fn(
     aggregator: Aggregator | None = None,
     epochs: int = 1,
     exchange_dtype: Any | None = None,
+    shared_aggregate: bool = False,
 ) -> Callable:
     """Build the jittable ``round_fn(fed, x, y, mask, n_samples, plan
     arrays) -> (fed, metrics)``.
@@ -181,6 +182,16 @@ def build_round_fn(
     epoch — the bench's rounds-to-80% guards the claim empirically.
     ``None`` keeps the exchange in full precision (the parity-test
     default).
+
+    ``shared_aggregate=True`` computes ONE robust aggregate from the
+    union of the mixing rows instead of one per row — for plans whose
+    aggregating rows are all identical (fully-connected DFL, or
+    CFL/SDFL where only the leader's row is nonzero). The vmapped
+    per-row path is O(n) redundant aggregations and O(n x |params|)
+    transient memory for those plans; on big models (ViT + Krum at 32
+    nodes) that redundancy is the difference between fitting and
+    faulting. Semantically identical where the contract holds; rows
+    with no incoming weight still keep their own params.
     """
     aggregator = aggregator or FedAvg()
     fedavg_fast = type(aggregator) is FedAvg
@@ -220,22 +231,38 @@ def build_round_fn(
                 else jax.tree.map(lambda p: p.astype(exchange_dtype),
                                   states.params)
             )
-
-            def per_row(row_w):
+            if shared_aggregate:
+                # uniform-row contract: one aggregate serves everyone
+                w_union = jnp.max(w, axis=0)
                 out = aggregator.aggregate(
                     stack_ex, n_samples.astype(jnp.float32),
-                    mask=row_w > 0,
+                    mask=w_union > 0,
                 )
-                return jax.tree.map(
-                    lambda o, p: o.astype(p.dtype), out, states.params
+                agg = jax.tree.map(
+                    lambda o, p: jnp.broadcast_to(
+                        o.astype(p.dtype)[None], p.shape
+                    ),
+                    out, states.params,
                 )
+            else:
+                def per_row(row_w):
+                    out = aggregator.aggregate(
+                        stack_ex, n_samples.astype(jnp.float32),
+                        mask=row_w > 0,
+                    )
+                    return jax.tree.map(
+                        lambda o, p: o.astype(p.dtype), out, states.params
+                    )
 
-            agg = jax.vmap(per_row)(w)
+                agg = jax.vmap(per_row)(w)
 
         # nodes with an all-zero row (nothing arrived before "timeout",
         # aggregator.py:53-76) keep their own params
         got_any = jnp.sum(w, axis=1) > 0
-        agg = jax.tree.map(lambda a: a[adopt], agg)
+        if not (shared_aggregate and not fedavg_fast):
+            # shared aggregates are already identical across rows, so
+            # the adopt gather would only copy
+            agg = jax.tree.map(lambda a: a[adopt], agg)
         keep = jnp.logical_and(alive, got_any[adopt])
         params = _tree_sel(keep, agg, states.params)
 

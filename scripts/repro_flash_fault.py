@@ -1,0 +1,48 @@
+"""Reproduce the intermittent Mosaic fault in ops.flash on the real
+chip: loop vmapped fwd+bwd flash attention at the vit32 bench shapes
+(vmap over 32 nodes x batch 115 x seq 64 x 3 heads x d 64) with
+changing allocations between iterations to vary buffer addresses."""
+
+from __future__ import annotations
+
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import jax
+import jax.numpy as jnp
+
+from p2pfl_tpu.ops.flash import flash_attention
+
+
+def main(iters: int = 300) -> None:
+    key = jax.random.PRNGKey(0)
+
+    def loss(q, k, v):
+        return jnp.sum(flash_attention(q, k, v) ** 2)
+
+    # vmap over a leading "nodes" axis like the federated ViT does
+    grad = jax.jit(jax.vmap(jax.grad(loss, argnums=(0, 1, 2))))
+
+    t0 = time.monotonic()
+    for i in range(iters):
+        kq, kk, kv, knoise, key = jax.random.split(key, 5)
+        shape = (32, 115, 64, 3, 64)
+        q = jax.random.normal(kq, shape, jnp.bfloat16)
+        k = jax.random.normal(kk, shape, jnp.bfloat16)
+        v = jax.random.normal(kv, shape, jnp.bfloat16)
+        # churn the allocator so buffers land at different addresses
+        junk = jax.random.normal(knoise, (1 + (i % 7), 1024, 1024))
+        g = grad(q, k, v)
+        jax.block_until_ready(g)
+        del junk
+        if i % 25 == 0:
+            print(f"iter {i} ok ({time.monotonic()-t0:.0f}s)", flush=True)
+    print(f"completed {iters} iterations without fault "
+          f"({time.monotonic()-t0:.0f}s)")
+
+
+if __name__ == "__main__":
+    main(int(sys.argv[1]) if len(sys.argv) > 1 else 300)

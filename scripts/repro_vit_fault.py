@@ -1,0 +1,60 @@
+"""Bisect the intermittent TPU fault: ViT fwd+bwd in a loop, vmapped
+over 32 nodes, toggling {use_flash, remat, scan_layers}. Run each
+combo in a FRESH process: python scripts/repro_vit_fault.py F R S N
+(F/R/S in {0,1}, N iterations)."""
+
+from __future__ import annotations
+
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+def main(use_flash: bool, remat: bool, scan_layers: bool,
+         iters: int = 150) -> None:
+    from p2pfl_tpu.models import get_model
+
+    model = get_model("vit-tiny", use_flash=use_flash, remat=remat,
+                      scan_layers=scan_layers)
+    n, bsz = 32, 115
+    key = jax.random.PRNGKey(0)
+    x1 = jnp.zeros((1, 32, 32, 3), jnp.float32)
+    rngs = jax.random.split(key, n)
+    params = jax.jit(jax.vmap(lambda r: model.init(r, x1)))(rngs)
+    tx = optax.adam(1e-3)
+    opt = jax.jit(jax.vmap(tx.init))(params)
+
+    def per_node(p, o, xb, yb):
+        def loss(pp):
+            return optax.softmax_cross_entropy_with_integer_labels(
+                model.apply(pp, xb), yb).mean()
+        l, g = jax.value_and_grad(loss)(p)
+        up, o2 = tx.update(g, o, p)
+        return optax.apply_updates(p, up), o2, l
+
+    step = jax.jit(jax.vmap(per_node))
+    t0 = time.monotonic()
+    for i in range(iters):
+        kx, ky, kj, key = jax.random.split(key, 4)
+        x = jax.random.normal(kx, (n, bsz, 32, 32, 3), jnp.float32)
+        y = jax.random.randint(ky, (n, bsz), 0, 10)
+        junk = jax.random.normal(kj, (1 + (i % 5), 1024, 1024))
+        params, opt, l = step(params, opt, x, y)
+        float(jnp.sum(l))
+        del junk
+        if i % 20 == 0:
+            print(f"iter {i} ok ({time.monotonic()-t0:.0f}s)", flush=True)
+    print(f"CLEAN {iters} iters flash={use_flash} remat={remat} "
+          f"scan={scan_layers} ({time.monotonic()-t0:.0f}s)")
+
+
+if __name__ == "__main__":
+    f, r, s = (bool(int(a)) for a in sys.argv[1:4])
+    n = int(sys.argv[4]) if len(sys.argv) > 4 else 150
+    main(f, r, s, n)

@@ -188,3 +188,52 @@ def test_ring_topology_converges_slower_but_learns(setup):
     # ring: node 0 and node 4 are not neighbors → params differ
     a, b = _params_row(fed, 0), _params_row(fed, 4)
     assert any(not np.allclose(pa, pb) for pa, pb in zip(a, b))
+
+
+def test_shared_aggregate_matches_per_row():
+    """shared_aggregate=True must equal the vmapped per-row path
+    wherever its uniform-row contract holds (fully-connected DFL and
+    single-leader CFL), including dead-node keep semantics."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from p2pfl_tpu.config.schema import DataConfig
+    from p2pfl_tpu.core.aggregators import Krum, TrimmedMean
+    from p2pfl_tpu.datasets import FederatedDataset
+    from p2pfl_tpu.learning.learner import make_step_fns
+    from p2pfl_tpu.models import get_model
+    from p2pfl_tpu.parallel.federated import (
+        build_round_fn,
+        init_federation,
+        make_round_plan,
+    )
+    from p2pfl_tpu.topology.topology import generate_topology
+
+    n = 4
+    ds = FederatedDataset.make(
+        DataConfig(dataset="mnist", samples_per_node=64, batch_size=32), n)
+    x, y, smask, nsamp = ds.stacked()
+    fns = make_step_fns(get_model("mnist-mlp"), learning_rate=0.05,
+                        batch_size=32)
+    topo = generate_topology("fully", n)
+
+    for federation, agg in (("DFL", Krum(f=0, m=2)),
+                            ("CFL", TrimmedMean(beta=1))):
+        plan = make_round_plan(topo, ["aggregator"] * n, federation)
+        fed_a = init_federation(fns, jnp.asarray(x[0, :1]), n, seed=1)
+        fed_b = init_federation(fns, jnp.asarray(x[0, :1]), n, seed=1)
+        # one dead node exercises the keep-own-params path
+        alive = jnp.array([True, True, True, False])
+        fed_a = fed_a.replace(alive=alive)
+        fed_b = fed_b.replace(alive=alive)
+        args = [jnp.asarray(a) for a in (x, y, smask, nsamp, plan.mix,
+                                         plan.adopt, plan.trains)]
+        ra = build_round_fn(fns, aggregator=agg, epochs=1)
+        rb = build_round_fn(fns, aggregator=agg, epochs=1,
+                            shared_aggregate=True)
+        fa, _ = ra(fed_a, *args)
+        fb, _ = rb(fed_b, *args)
+        for la, lb in zip(jax.tree.leaves(fa.states.params),
+                          jax.tree.leaves(fb.states.params)):
+            np.testing.assert_allclose(np.asarray(la), np.asarray(lb),
+                                       rtol=1e-5, atol=1e-6)

@@ -55,6 +55,9 @@ def main(use_flash: bool, remat: bool, scan_layers: bool,
 
 
 if __name__ == "__main__":
+    if len(sys.argv) < 4:
+        sys.exit("usage: repro_vit_fault.py F R S [iters]  "
+                 "(use_flash remat scan_layers, each 0/1)")
     f, r, s = (bool(int(a)) for a in sys.argv[1:4])
     n = int(sys.argv[4]) if len(sys.argv) > 4 else 150
     main(f, r, s, n)

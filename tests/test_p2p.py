@@ -528,3 +528,31 @@ def test_cfl_socket_federation_server_aggregates():
                 await node.stop()
 
     asyncio.run(main())
+
+
+def test_run_simulation_inprocess():
+    """launch.run_simulation: the reference's simulation mode (all
+    nodes in one process, SURVEY §4) — SharedTrainer compiles once,
+    timing and mean accuracy come back, netem config is honored."""
+    from p2pfl_tpu.config.schema import (
+        DataConfig as DC,
+        NetworkConfig,
+        ScenarioConfig,
+        TrainingConfig,
+    )
+    from p2pfl_tpu.p2p.launch import run_simulation
+
+    cfg = ScenarioConfig(
+        name="sim4", n_nodes=4, topology="ring",
+        data=DC(dataset="mnist", samples_per_node=100),
+        training=TrainingConfig(rounds=2, epochs_per_round=1,
+                                learning_rate=0.05),
+        protocol=ProtocolConfig(heartbeat_period_s=0.3,
+                                aggregation_timeout_s=30.0,
+                                vote_timeout_s=5.0),
+        network=NetworkConfig(delay_ms=5, seed=2),
+    )
+    out = run_simulation(cfg, timeout=240)
+    assert out["n_nodes"] == 4 and out["rounds"] == 2
+    assert out["round_s"] > 0
+    assert out["mean_accuracy"] is None or 0.0 <= out["mean_accuracy"] <= 1.0

@@ -434,6 +434,29 @@ class JaxLearner(NodeLearner):
                 step=self.global_step + steps, round=self.round,
             )
 
+    def warm_up(self) -> None:
+        """Compile fit's and evaluate's programs for THIS learner's
+        data shapes without mutating state — callers measuring
+        steady-state rounds warm before starting the clock. Mirrors
+        fit()/evaluate()'s exact argument construction so the compiled
+        shapes are the ones later calls hit (fit always dispatches
+        epochs=1 programs — multi-epoch fits loop them)."""
+        if self.fns is None:
+            self.create_trainer()
+        if self.state is None:
+            self.init()
+        x = jnp.asarray(self.data.x)
+        y = jnp.asarray(self.data.y)
+        mask = jnp.ones(len(self.data.x), bool)
+        self._train_jit(self.state, x, y, mask, epochs=1)
+        xe = jnp.asarray(
+            self.data.x_val if len(self.data.x_val) else self.data.x
+        )
+        ye = jnp.asarray(
+            self.data.y_val if len(self.data.x_val) else self.data.y
+        )
+        self._eval_jit(self.state.params, xe, ye, jnp.ones(len(xe), bool))
+
     def interrupt_fit(self) -> None:
         """Best-effort stop (lightninglearner.py:122-125). A jitted
         epoch is a single device program, so interruption takes effect

@@ -212,6 +212,10 @@ async def _simulate(cfg: ScenarioConfig, timeout: float = 600) -> dict:
         (i for i, nc in enumerate(cfg.nodes) if nc.start), 0
     )
     nodes[starter].learner.init()
+    # warm the shared compiled programs before the clock starts: the
+    # first fit/evaluate would otherwise bill their jit compiles to
+    # round 1 and skew the steady-state round time being measured
+    nodes[starter].learner.warm_up()
     t0 = time.monotonic()
     nodes[starter].set_start_learning(
         cfg.training.rounds, cfg.training.epochs_per_round

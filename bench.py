@@ -560,14 +560,24 @@ print("BENCH_SOCK24 " + json.dumps(run_simulation(cfg, timeout=280)))
 
 
 def main() -> None:
+    import sys
+
+    t_start = time.monotonic()
+
+    def _phase(name: str) -> None:
+        print(f"bench phase {name} at +{time.monotonic() - t_start:.0f}s",
+              file=sys.stderr, flush=True)
+
     # vit32 runs FIRST, in a subprocess, before this process touches
     # the TPU: its Pallas kernels need a fresh chip (see _vit32), and
     # a child kernel fault must not take the whole bench down
+    _phase("vit32")
     vit = _vit32()
 
     import jax
 
     # ---- headline: 64-node FEMNIST-CNN ring -------------------------
+    _phase("headline")
     run = _build(64)
     round_s = _time_chained(run)
     direct = _round_flops(run["round_fn"], run["fed"], run["fargs"])
@@ -578,15 +588,21 @@ def main() -> None:
     achieved = flops / round_s if flops else None
     mfu = achieved / (peak * len(jax.devices())) if achieved and peak else None
 
+    _phase("headline trajectory")
     rounds_to_80, seconds_to_80, final_acc, _ = _accuracy_run(run)
 
     # ---- round-1/2 continuity metric (8-node, batch 64, f32) --------
+    _phase("8-node continuity")
     run8 = _build(8, batch_size=64, exchange_dtype="f32")
     round_s_8 = _time_rounds_synced(run8)
 
+    _phase("cifar16")
     cifar = _cifar16()
+    _phase("cpu8")
     cpu8 = _sparse_vs_dense_cpu()
+    _phase("socket24")
     sock24 = _socket24()
+    _phase("done")
 
     print(
         json.dumps(

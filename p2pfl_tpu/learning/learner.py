@@ -393,15 +393,30 @@ class JaxLearner(NodeLearner):
     def set_epochs(self, epochs: int) -> None:
         self.epochs = epochs
 
+    def _fit_args(self):
+        """fit()'s device-call arguments — one definition shared with
+        warm_up() so the warmed shapes are exactly the ones fit hits."""
+        x = jnp.asarray(self.data.x)
+        y = jnp.asarray(self.data.y)
+        return x, y, jnp.ones(len(self.data.x), bool)
+
+    def _eval_args(self):
+        """evaluate()'s device-call arguments (val split when present)."""
+        x = jnp.asarray(
+            self.data.x_val if len(self.data.x_val) else self.data.x
+        )
+        y = jnp.asarray(
+            self.data.y_val if len(self.data.x_val) else self.data.y
+        )
+        return x, y, jnp.ones(len(x), bool)
+
     def fit(self) -> None:
         if self.epochs <= 0:
             return
         if self._interrupted:  # honor a pending interrupt_fit()
             self._interrupted = False
             return
-        x = jnp.asarray(self.data.x)
-        y = jnp.asarray(self.data.y)
-        mask = jnp.ones(len(self.data.x), bool)
+        x, y, mask = self._fit_args()
         t0 = time.monotonic()
         if self.epochs == 1:
             self.state, metrics = self._train_jit(self.state, x, y, mask,
@@ -435,27 +450,22 @@ class JaxLearner(NodeLearner):
             )
 
     def warm_up(self) -> None:
-        """Compile fit's and evaluate's programs for THIS learner's
-        data shapes without mutating state — callers measuring
-        steady-state rounds warm before starting the clock. Mirrors
-        fit()/evaluate()'s exact argument construction so the compiled
-        shapes are the ones later calls hit (fit always dispatches
-        epochs=1 programs — multi-epoch fits loop them)."""
+        """Populate the jit cache for fit's and evaluate's programs at
+        THIS learner's data shapes — callers measuring steady-state
+        rounds warm before starting the clock. AOT lower+compile: no
+        device execution is queued (a real warm epoch would still be
+        draining when the caller starts its timer), and the argument
+        construction is the same `_fit_args`/`_eval_args` the live
+        calls use (fit always dispatches epochs=1 programs —
+        multi-epoch fits loop them)."""
         if self.fns is None:
             self.create_trainer()
         if self.state is None:
             self.init()
-        x = jnp.asarray(self.data.x)
-        y = jnp.asarray(self.data.y)
-        mask = jnp.ones(len(self.data.x), bool)
-        self._train_jit(self.state, x, y, mask, epochs=1)
-        xe = jnp.asarray(
-            self.data.x_val if len(self.data.x_val) else self.data.x
-        )
-        ye = jnp.asarray(
-            self.data.y_val if len(self.data.x_val) else self.data.y
-        )
-        self._eval_jit(self.state.params, xe, ye, jnp.ones(len(xe), bool))
+        x, y, mask = self._fit_args()
+        self._train_jit.lower(self.state, x, y, mask, epochs=1).compile()
+        xe, ye, me = self._eval_args()
+        self._eval_jit.lower(self.state.params, xe, ye, me).compile()
 
     def interrupt_fit(self) -> None:
         """Best-effort stop (lightninglearner.py:122-125). A jitted
@@ -465,9 +475,7 @@ class JaxLearner(NodeLearner):
         self._interrupted = True
 
     def evaluate(self):
-        x = jnp.asarray(self.data.x_val if len(self.data.x_val) else self.data.x)
-        y = jnp.asarray(self.data.y_val if len(self.data.x_val) else self.data.y)
-        mask = jnp.ones(len(x), bool)
+        x, y, mask = self._eval_args()
         metrics = self._eval_jit(self.state.params, x, y, mask)
         out = {k: float(v) for k, v in metrics.items()}
         if self.logger is not None:

@@ -212,10 +212,13 @@ async def _simulate(cfg: ScenarioConfig, timeout: float = 600) -> dict:
         (i for i, nc in enumerate(cfg.nodes) if nc.start), 0
     )
     nodes[starter].learner.init()
-    # warm the shared compiled programs before the clock starts: the
-    # first fit/evaluate would otherwise bill their jit compiles to
-    # round 1 and skew the steady-state round time being measured
-    nodes[starter].learner.warm_up()
+    # warm EVERY node's compiled programs before the clock starts
+    # (ragged dirichlet shards mean distinct shapes per node; the jit
+    # cache dedups identical ones, so iid costs one compile): the
+    # first fit/evaluate would otherwise bill their compiles to round
+    # 1 and skew the steady-state round time being measured
+    for node in nodes:
+        node.learner.warm_up()
     t0 = time.monotonic()
     nodes[starter].set_start_learning(
         cfg.training.rounds, cfg.training.epochs_per_round

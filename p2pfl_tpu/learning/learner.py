@@ -462,10 +462,19 @@ class JaxLearner(NodeLearner):
             self.create_trainer()
         if self.state is None:
             self.init()
-        x, y, mask = self._fit_args()
-        self._train_jit.lower(self.state, x, y, mask, epochs=1).compile()
-        xe, ye, me = self._eval_args()
-        self._eval_jit.lower(self.state.params, xe, ye, me).compile()
+
+        def avals(args):
+            # .lower() needs only shapes/dtypes — materializing every
+            # node's whole shard on device just to read its aval would
+            # double the federation's host->device traffic
+            return tuple(
+                jax.ShapeDtypeStruct(a.shape, a.dtype) for a in args
+            )
+
+        self._train_jit.lower(self.state, *avals(self._fit_args()),
+                              epochs=1).compile()
+        self._eval_jit.lower(self.state.params,
+                             *avals(self._eval_args())).compile()
 
     def interrupt_fit(self) -> None:
         """Best-effort stop (lightninglearner.py:122-125). A jitted

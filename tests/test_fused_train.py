@@ -1,0 +1,120 @@
+"""Pallas fused MLP train-epoch kernel (ops.fused_train): parity with
+a plain-JAX implementation of the same SGD+momentum epoch. The kernel
+is the round-4 integration target (docs/perf.md §4): params+momentum
+stay in VMEM across every step of a node's epoch."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from p2pfl_tpu.ops.fused_train import (
+    fused_mlp_train_epoch,
+    mlp_params_to_tuple,
+    tuple_to_mlp_params,
+)
+
+
+def _make(n=3, d_in=784, d1=256, d2=128, classes=10, seed=0):
+    key = jax.random.PRNGKey(seed)
+    ks = jax.random.split(key, 7)
+    params = (
+        jax.random.normal(ks[0], (n, d_in, d1)) * 0.05,
+        jnp.zeros((n, 1, d1)),
+        jax.random.normal(ks[1], (n, d1, d2)) * 0.05,
+        jnp.zeros((n, 1, d2)),
+        jax.random.normal(ks[2], (n, d2, classes)) * 0.05,
+        jnp.zeros((n, 1, classes)),
+    )
+    mom = tuple(jnp.zeros_like(p) for p in params)
+    bx = jax.random.normal(ks[3], (n, 96, d_in))
+    by = jax.random.randint(ks[4], (n, 96, 1), 0, classes)
+    return params, mom, bx, by
+
+
+def _reference_epoch(params, mom, bx, by, lr, momentum, batch):
+    """Plain-JAX oracle: same math, mean-CE, optax-style momentum."""
+
+    def loss_fn(p, x, y):
+        w0, b0, w1, b1, w2, b2 = p
+        h0 = jax.nn.relu(x @ w0 + b0[0])
+        h1 = jax.nn.relu(h0 @ w1 + b1[0])
+        logits = h1 @ w2 + b2[0]
+        logp = jax.nn.log_softmax(logits)
+        oh = jax.nn.one_hot(y[:, 0], logits.shape[-1])
+        return -jnp.mean(jnp.sum(oh * logp, axis=-1))
+
+    def node_epoch(p, m, x, y):
+        steps = x.shape[0] // batch
+        losses = []
+        for s in range(steps):
+            xb = x[s * batch:(s + 1) * batch]
+            yb = y[s * batch:(s + 1) * batch]
+            l, g = jax.value_and_grad(loss_fn)(p, xb, yb)
+            m = tuple(momentum * mi + gi for mi, gi in zip(m, g))
+            p = tuple(pi - lr * mi for pi, mi in zip(p, m))
+            losses.append(l)
+        return p, m, jnp.mean(jnp.stack(losses))
+
+    outs = [node_epoch(tuple(pp[i] for pp in params),
+                       tuple(mm[i] for mm in mom), bx[i], by[i])
+            for i in range(bx.shape[0])]
+    new_p = tuple(jnp.stack([o[0][j] for o in outs]) for j in range(6))
+    new_m = tuple(jnp.stack([o[1][j] for o in outs]) for j in range(6))
+    loss = jnp.stack([o[2] for o in outs])
+    return new_p, new_m, loss
+
+
+def test_fused_epoch_parity():
+    params, mom, bx, by = _make()
+    lr, beta, batch = 0.05, 0.9, 32
+    kp, km, kl = fused_mlp_train_epoch(params, mom, bx, by, lr, beta,
+                                       batch_size=batch, interpret=True)
+    rp, rm, rl = _reference_epoch(params, mom, bx, by, lr, beta, batch)
+    for a, b in zip(kp, rp):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-5)
+    for a, b in zip(km, rm):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(kl), np.asarray(rl),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_fused_epoch_learns():
+    """Loss falls across epochs on a learnable task."""
+    params, mom, bx, by = _make(n=2, seed=3)
+    losses = []
+    for _ in range(5):
+        params, mom, loss = fused_mlp_train_epoch(
+            params, mom, bx, by, 0.05, 0.9, batch_size=32, interpret=True)
+        losses.append(float(jnp.mean(loss)))
+    assert losses[-1] < losses[0] * 0.8, losses
+
+
+def test_flax_param_bridge_roundtrip():
+    from p2pfl_tpu.models import get_model
+
+    model = get_model("mnist-mlp")
+    x1 = jnp.zeros((1, 28, 28, 1))
+    stacked = jax.vmap(lambda r: model.init(r, x1))(
+        jax.random.split(jax.random.PRNGKey(0), 2))
+    t = mlp_params_to_tuple(stacked)
+    assert t[0].ndim == 3 and t[1].shape[1] == 1
+    back = tuple_to_mlp_params(t)
+    for a, b in zip(jax.tree.leaves(stacked), jax.tree.leaves(back)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_short_shard_single_step():
+    """Shards smaller than one batch collapse to a single full-shard
+    step (mirrors make_step_fns' min(batch, s) behavior)."""
+    params, mom, bx, by = _make(n=2)
+    bx, by = bx[:, :20], by[:, :20]
+    kp, km, kl = fused_mlp_train_epoch(params, mom, bx, by, 0.05, 0.9,
+                                       batch_size=32, interpret=True)
+    rp, rm, rl = _reference_epoch(params, mom, bx, by, 0.05, 0.9, 20)
+    for a, b in zip(kp + km, rp + rm):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(kl), np.asarray(rl),
+                               rtol=1e-4, atol=1e-5)

@@ -104,8 +104,10 @@ def make_step_fns(
     # momentum on frozen nodes. adamw keeps its decoupled decay — its
     # decay rides the updates, which the gate also zeroes.
     explicit_decay = weight_decay if optimizer.lower() != "adamw" else 0.0
-    tx = make_optimizer(optimizer, learning_rate, momentum,
-                        0.0 if explicit_decay else weight_decay)
+    tx = make_optimizer(
+        optimizer, learning_rate, momentum,
+        weight_decay if optimizer.lower() == "adamw" else 0.0,
+    )
 
     def init(rng, sample_x) -> TrainState:
         params = model.init(rng, sample_x)
@@ -131,7 +133,14 @@ def make_step_fns(
         MXU at memory speed (~4 ms measured). Exact for float inputs:
         each output row is 1.0 * one source row, and f32*1.0 followed
         by a sum of zeros is bit-exact. Integer/bool inputs (labels,
-        masks, token ids) keep the gather — their rows are tiny."""
+        masks, token ids) keep the gather — their rows are tiny.
+
+        Precondition: finite inputs. 0.0 * Inf/NaN is NaN, so one
+        non-finite sample row would poison its column in EVERY output
+        row, where the gather kept corruption local to one sample. The
+        dataset loaders normalize real files to finite pixel ranges;
+        the exactness claim and this containment boundary are pinned
+        by tests/test_learner_shuffle.py."""
         # one-hot is O(s^2) in shard size — a federated shard (<=4k
         # rows) wins big, but a single-node learner training a whole
         # 20k-row dataset would materialize a [20k,20k] matrix; the

@@ -89,7 +89,10 @@ class ViT(nn.Module):
     dtype: jnp.dtype = jnp.bfloat16
     param_dtype: jnp.dtype = jnp.float32
     seq_axis: str | None = None
-    use_flash: bool = False
+    use_flash: bool = False  # pair with remat=True at federation scale:
+    # the flash kernels save lane-replicated (128x) softmax stats as
+    # backward residuals (ops/flash.py _STATS_LANES); remat recomputes
+    # them per block instead of holding nodes x batch x heads of them
     remat: bool = False  # jax.checkpoint each block: trade recompute
     # for ~depth x less activation memory — lets a federation of many
     # ViT replicas (vmapped per-node weights) fit a single chip's HBM

@@ -32,7 +32,16 @@ import jax.numpy as jnp
 
 
 #: lane width of the saved softmax stats (lse/delta): a full TPU lane
-#: tile, value replicated, instead of a degenerate lane-dim-1 layout
+#: tile, value replicated, instead of a degenerate lane-dim-1 layout.
+#: Cost: 128x the residual memory of a [b*h, sq] stats layout. That is
+#: fine under remat (the stats are recomputed per backward block, not
+#: saved across the whole forward), which is how the federated ViT
+#: configs run flash (use_flash is expected to pair with remat=True —
+#: noted at models/vit.py); with
+#: remat=False at federation scale (vmapped nodes x batch x heads) the
+#: saved residual grows ~128x into GBs — if a no-remat flash path is
+#: ever needed, switch to the [b*h, sq] layout with sq in the lane
+#: dimension first.
 _STATS_LANES = 128
 
 

@@ -1,0 +1,124 @@
+"""User accounts for the dashboard — the reference's users table and
+role model (webserver/database.py:54-120: user/password/role rows,
+scrypt-hashed, admin vs user) as a single JSON file, stdlib-only.
+
+Storage: ``users.json`` mapping username -> {salt, hash, role}, where
+``hash`` is PBKDF2-HMAC-SHA256(password, salt, 200k iters). Writes are
+atomic (tmp + replace) so a crashed CRUD call cannot truncate the
+store, matching the framework's filesystem-as-database discipline.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import hashlib
+import hmac
+import json
+import os
+import pathlib
+import secrets
+
+ROLES = ("admin", "user")
+_ITERS = 200_000
+
+
+def _hash(password: str, salt: bytes) -> bytes:
+    return hashlib.pbkdf2_hmac("sha256", password.encode(), salt, _ITERS)
+
+
+class UserStore:
+    """CRUD + verification over the on-disk user file.
+
+    The file is re-read on every call: the webapp's management CLI and
+    a running server may touch the same store, and user CRUD is far
+    too rare to justify a cache with an invalidation story.
+    """
+
+    def __init__(self, path: str | pathlib.Path):
+        self.path = pathlib.Path(path)
+
+    @contextlib.contextmanager
+    def _locked(self):
+        """Advisory lock around read-modify-write: concurrent CRUD
+        (ThreadingHTTPServer handlers, or the --add-user CLI beside a
+        running server) must not lose updates to a last-writer-wins
+        race. flock covers both threads and processes on this OS; if
+        it is unavailable the RMW proceeds unlocked (rare-platform
+        degradation, not a failure)."""
+        self.path.parent.mkdir(parents=True, exist_ok=True)
+        lock_path = self.path.with_suffix(".lock")
+        try:
+            import fcntl
+
+            with open(lock_path, "w") as lk:
+                fcntl.flock(lk, fcntl.LOCK_EX)
+                try:
+                    yield
+                finally:
+                    fcntl.flock(lk, fcntl.LOCK_UN)
+        except ImportError:
+            yield
+
+    def _load(self) -> dict:
+        if not self.path.is_file():
+            return {}
+        try:
+            data = json.loads(self.path.read_text())
+            return data if isinstance(data, dict) else {}
+        except ValueError:
+            return {}
+
+    def _save(self, data: dict) -> None:
+        self.path.parent.mkdir(parents=True, exist_ok=True)
+        tmp = self.path.with_suffix(".tmp")
+        tmp.write_text(json.dumps(data, indent=1))
+        os.replace(tmp, self.path)
+
+    def add(self, user: str, password: str, role: str = "user") -> None:
+        """Create or update a user (the reference's add/update rows,
+        database.py:88-112)."""
+        if role not in ROLES:
+            raise ValueError(f"role must be one of {ROLES}")
+        if not user or not password:
+            raise ValueError("user and password must be non-empty")
+        with self._locked():
+            data = self._load()
+            salt = secrets.token_bytes(16)
+            data[user] = {
+                "salt": salt.hex(),
+                "hash": _hash(password, salt).hex(),
+                "role": role,
+            }
+            self._save(data)
+
+    def remove(self, user: str) -> bool:
+        with self._locked():
+            data = self._load()
+            if user not in data:
+                return False
+            del data[user]
+            self._save(data)
+            return True
+
+    def verify(self, user: str, password: str) -> str | None:
+        """Role on success, None on unknown user or bad password.
+        Constant-time digest compare; unknown users still burn a hash
+        so a timing probe cannot enumerate usernames."""
+        data = self._load()
+        rec = data.get(user)
+        if rec is None:
+            _hash(password, b"\x00" * 16)
+            return None
+        try:
+            salt = bytes.fromhex(rec["salt"])
+            want = bytes.fromhex(rec["hash"])
+        except (KeyError, ValueError):
+            return None
+        if hmac.compare_digest(_hash(password, salt), want):
+            return rec.get("role", "user")
+        return None
+
+    def list(self) -> dict[str, str]:
+        """username -> role (no secrets leave the store)."""
+        return {u: rec.get("role", "user")
+                for u, rec in sorted(self._load().items())}

@@ -16,10 +16,13 @@ reads the same on-disk artifacts the framework already writes
 - ``/api/metrics/<name>``  — JSON tail of the metrics stream
 - ``/logs/<name>/<file>``  — tail of a node's log file, rendered
 
-Write routes (token-authenticated — the reference gates these behind
-login/session auth, app.py:195-254; here every mutating request must
-carry the shared token as ``Authorization: Bearer <token>`` or an
-``X-Auth-Token`` header / ``token`` form field):
+Write routes (authenticated two ways, mirroring the reference's two
+client classes: browsers get login/session-cookie auth with role-gated
+user administration — webserver/app.py:195-254, users table
+database.py:54-120 — via ``/login`` + a ``users.json`` store
+(`p2pfl_tpu.users`); automation keeps the shared bearer token as
+``Authorization: Bearer <token>`` / ``X-Auth-Token`` header / ``token``
+form field):
 
 - ``POST /api/scenario/run``          — deploy: accepts a ScenarioConfig
   JSON body (or the designer's form), stamps it under the log root and
@@ -31,6 +34,19 @@ carry the shared token as ``Authorization: Bearer <token>`` or an
   (app.py:545-555)
 - ``POST /api/scenario/<name>/reload``— re-deploy from the scenario's
   saved config (app.py:694-714)
+
+Session surface (enabled by ``--users users.json``):
+
+- ``GET/POST /login`` — login form; sets an HttpOnly session cookie
+- ``POST /logout``    — drops the session
+- ``GET /admin/users``, ``POST /api/users/add|remove`` — admin-role
+  user CRUD (the reference's user administration, app.py:222-254)
+
+Charts: ``/charts/<name>`` renders per-node scalar curves (loss,
+accuracy, ...) from ``metrics.jsonl`` as inline SVG — the role of the
+reference's proxied TensorBoard statistics frontend
+(controller.py:184-202, webserver/app.py:562-583) without spawning a
+server per scenario.
 
 The filesystem IS the database: node upserts are the atomic
 ``node_*.status.json`` replaces (webserver/database.py:253-274's
@@ -47,6 +63,7 @@ from __future__ import annotations
 import argparse
 import html
 import json
+import math
 import pathlib
 import secrets
 import shutil
@@ -125,7 +142,9 @@ def tail_metrics(root: pathlib.Path, name: str, n: int = 200) -> list[dict]:
     path = root / name / "metrics.jsonl"
     if not path.exists():
         return []
-    lines = _tail_text(path, max_bytes=256 * 1024).splitlines()[-n:]
+    lines = _tail_text(
+        path, max_bytes=max(256 * 1024, n * 256)
+    ).splitlines()[-n:]
     out = []
     for line in lines:
         try:
@@ -133,6 +152,200 @@ def tail_metrics(root: pathlib.Path, name: str, n: int = 200) -> list[dict]:
         except ValueError:
             continue
     return out
+
+
+class Sessions:
+    """In-memory session cookies (the reference keeps Flask server-side
+    sessions; a dashboard restart logging everyone out is acceptable —
+    and means no session secrets ever touch disk)."""
+
+    def __init__(self, ttl_s: float = 12 * 3600):
+        import threading
+
+        self.ttl_s = ttl_s
+        self._lock = threading.Lock()
+        self._sessions: dict[str, dict] = {}
+
+    def create(self, user: str, role: str) -> str:
+        token = secrets.token_urlsafe(24)
+        with self._lock:
+            self._sessions[token] = {
+                "user": user, "role": role,
+                "expires": time.time() + self.ttl_s,
+            }
+        return token
+
+    def get(self, token: str | None) -> dict | None:
+        if not token:
+            return None
+        with self._lock:
+            s = self._sessions.get(token)
+            if s is None:
+                return None
+            if s["expires"] < time.time():
+                del self._sessions[token]
+                return None
+            return dict(s)
+
+    def drop(self, token: str | None) -> None:
+        with self._lock:
+            self._sessions.pop(token, None)
+
+    def drop_user(self, user: str) -> None:
+        """Invalidate every session of one user — removal or a password
+        change must not leave a live cookie with write access."""
+        with self._lock:
+            for token in [t for t, s in self._sessions.items()
+                          if s["user"] == user]:
+                del self._sessions[token]
+
+
+# ---- SVG scalar charts (the TensorBoard-statistics role) ----------------
+
+# Validated dark categorical palette (adjacent-pairlist, dark chart
+# surface #1a1a19) — fixed slot order, assigned per node id, never
+# cycled past 8: beyond 8 nodes the per-node lines fold to a muted
+# single hue with the federation mean as the one highlighted series.
+_SERIES = ("#3987e5", "#d95926", "#199e70", "#c98500",
+           "#d55181", "#008300", "#9085e9", "#e66767")
+_CHART_SURFACE = "#1a1a19"
+_GRID, _AXIS, _MUTED, _INK = "#2c2c2a", "#383835", "#898781", "#e8e6dd"
+_MAX_COLORED_SERIES = 8
+_MAX_POINTS_PER_SERIES = 240
+
+
+def _metric_series(records: list[dict]) -> dict[str, dict[str, list]]:
+    """metric -> series-label -> [(step, value)], from metrics.jsonl
+    records. ``node: None`` records become the "federation" series;
+    the ``round_boundary`` markers are not scalar curves."""
+    out: dict[str, dict[str, list]] = {}
+    for rec in records:
+        node = rec.get("node")
+        label = "federation" if node is None else f"node {node}"
+        step = rec.get("step", 0)
+        if not isinstance(step, (int, float)):
+            continue  # foreign writer on the shared log volume
+        for key, val in rec.items():
+            if key in ("ts", "step", "round", "node", "round_boundary"):
+                continue
+            if not isinstance(val, (int, float)):
+                continue
+            # a diverged node's NaN/Inf (json.dumps happily writes bare
+            # NaN) must not poison the shared y-scale and blank every
+            # healthy node's curve
+            if not (math.isfinite(val) and math.isfinite(step)):
+                continue
+            out.setdefault(key, {}).setdefault(label, []).append(
+                (float(step), float(val))
+            )
+    return out
+
+
+def _ticks(lo: float, hi: float, n: int = 4) -> list[float]:
+    if hi <= lo:
+        return [lo]
+    span = hi - lo
+    return [lo + span * i / n for i in range(n + 1)]
+
+
+def _fmt(v: float) -> str:
+    a = abs(v)
+    if a and (a < 0.01 or a >= 10000):
+        return f"{v:.2e}"
+    return f"{v:.4g}"
+
+
+def _svg_chart(title: str, series: dict[str, list], w: int = 460,
+               h: int = 220) -> str:
+    """One scalar chart: 2px polylines on the validated dark surface,
+    hairline grid, muted axis labels, per-point <title> hover readout.
+    <= 8 series get the fixed categorical slots + a legend; more fold
+    to muted lines with the federation mean highlighted (identity is
+    then in the hover layer, not color)."""
+    pts = [p for s in series.values() for p in s]
+    if not pts:
+        return ""
+    ml, mr, mt, mb = 52, 10, 8, 22  # margins: left, right, top, bottom
+    x0, x1 = min(p[0] for p in pts), max(p[0] for p in pts)
+    y0, y1 = min(p[1] for p in pts), max(p[1] for p in pts)
+    if y1 == y0:
+        y0, y1 = y0 - 0.5, y1 + 0.5
+    if x1 == x0:
+        x1 = x0 + 1.0
+
+    def sx(x):
+        return round(ml + (x - x0) / (x1 - x0) * (w - ml - mr), 1)
+
+    def sy(y):
+        return round(h - mb - (y - y0) / (y1 - y0) * (h - mt - mb), 1)
+
+    grid = "".join(
+        f"<line x1='{ml}' y1='{sy(t)}' x2='{w - mr}' y2='{sy(t)}' "
+        f"stroke='{_GRID}' stroke-width='1'/>"
+        f"<text x='{ml - 6}' y='{sy(t) + 3}' fill='{_MUTED}' "
+        f"font-size='10' text-anchor='end'>{_fmt(t)}</text>"
+        for t in _ticks(y0, y1)
+    )
+    grid += "".join(
+        f"<text x='{sx(t)}' y='{h - 6}' fill='{_MUTED}' font-size='10' "
+        f"text-anchor='middle'>{_fmt(t)}</text>"
+        for t in _ticks(x0, x1, 3)
+    )
+
+    labels = sorted(series, key=lambda s: (s == "federation", s))
+    many = len(labels) > _MAX_COLORED_SERIES
+    lines, legend = [], []
+    for i, label in enumerate(labels):
+        data = sorted(series[label])
+        if len(data) > _MAX_POINTS_PER_SERIES:
+            # decimate long runs: the page is rebuilt per auto-refresh,
+            # and 10k hover circles per chart serve nobody. Keep the
+            # endpoints, stride the middle.
+            stride = (len(data) - 1) // (_MAX_POINTS_PER_SERIES - 1) + 1
+            data = data[::stride] + [data[-1]]
+        if many:
+            color = _SERIES[0] if label == "federation" else _MUTED
+            width = 2 if label == "federation" else 1
+        else:
+            color, width = _SERIES[i % len(_SERIES)], 2
+        path = " ".join(f"{sx(x)},{sy(y)}" for x, y in data)
+        lines.append(
+            f"<polyline points='{path}' fill='none' stroke='{color}' "
+            f"stroke-width='{width}' stroke-linejoin='round'/>"
+        )
+        esc = html.escape(label)
+        lines.extend(
+            f"<circle cx='{sx(x)}' cy='{sy(y)}' r='5' fill='transparent' "
+            f"stroke='none'><title>{esc}: {_fmt(y)} @ step {_fmt(x)}"
+            f"</title></circle>"
+            for x, y in data
+        )
+        if not many or label == "federation":
+            legend.append(
+                f"<span style='color:{_INK}'><span style='color:{color}'>"
+                f"&#9644;</span> {esc}</span>"
+            )
+    if many:
+        n_nodes = sum(1 for s in labels if s != "federation")
+        legend.append(
+            f"<span style='color:{_MUTED}'>&#9644; {n_nodes} nodes "
+            "(hover a point for identity)</span>"
+        )
+    return (
+        f"<div style='display:inline-block;margin:.4em'>"
+        f"<div style='color:{_INK};font-size:12px;padding:2px 0'>"
+        f"{html.escape(title)}</div>"
+        f"<svg width='{w}' height='{h}' role='img' "
+        f"aria-label='{html.escape(title)}'>"
+        f"<rect width='{w}' height='{h}' fill='{_CHART_SURFACE}'/>"
+        f"{grid}"
+        f"<line x1='{ml}' y1='{h - mb}' x2='{w - mr}' y2='{h - mb}' "
+        f"stroke='{_AXIS}' stroke-width='1'/>"
+        f"<line x1='{ml}' y1='{mt}' x2='{ml}' y2='{h - mb}' "
+        f"stroke='{_AXIS}' stroke-width='1'/>"
+        f"{''.join(lines)}</svg>"
+        f"<div style='font-size:11px'>{' '.join(legend)}</div></div>"
+    )
 
 
 class Deployments:
@@ -188,6 +401,8 @@ class DashboardHandler(BaseHTTPRequestHandler):
     root: pathlib.Path  # set by make_server
     token: str | None = None  # write-route auth; None disables writes
     deployments: Deployments  # set by make_server
+    users = None  # UserStore; None disables login/session auth
+    sessions: Sessions  # set by make_server
 
     def log_message(self, *args) -> None:  # quiet
         pass
@@ -229,15 +444,36 @@ class DashboardHandler(BaseHTTPRequestHandler):
 
     # ---- write surface ---------------------------------------------------
 
-    def _read_body(self) -> bytes:
-        length = int(self.headers.get("Content-Length") or 0)
-        return self.rfile.read(min(length, 1 << 20)) if length else b""
+    def _read_body(self) -> bytes | None:
+        """Request body, or None after replying 413: a truncated read
+        would parse as broken JSON (opaque 500) and leave the unread
+        bytes on the keep-alive connection to corrupt the next
+        pipelined request, so oversized bodies are rejected outright
+        and the connection closed."""
+        # clamp below 0: read(-1) would block to EOF on a keep-alive
+        # socket, tying a server thread up indefinitely
+        length = max(0, int(self.headers.get("Content-Length") or 0))
+        if length > (1 << 20):
+            self.close_connection = True
+            self._json_code({"error": "body too large (1 MiB cap)"}, 413)
+            return None
+        return self.rfile.read(length) if length else b""
 
-    def _authorized(self, form: dict | None = None) -> bool:
-        """Shared-token check on every mutating route (the reference
-        gates writes behind session auth, app.py:195-254). Constant-
-        time compare; a server started without a token refuses writes
-        outright rather than running them open."""
+    def _session_token(self) -> str | None:
+        cookie = self.headers.get("Cookie") or ""
+        for part in cookie.split(";"):
+            k, _, v = part.strip().partition("=")
+            if k == "p2pfl_session":
+                return v
+        return None
+
+    def _session(self) -> dict | None:
+        """Session record from the request's cookie, if valid."""
+        return self.sessions.get(self._session_token())
+
+    def _token_ok(self, form: dict | None = None) -> bool:
+        """Shared bearer-token check (API clients / automation).
+        Constant-time compare; no configured token = no token auth."""
         if self.token is None:
             return False
         auth = self.headers.get("Authorization") or ""
@@ -251,10 +487,28 @@ class DashboardHandler(BaseHTTPRequestHandler):
             c and secrets.compare_digest(c, self.token) for c in candidates
         )
 
+    def _authorized(self, form: dict | None = None) -> bool:
+        """Mutating routes: a valid login session (any role) or the
+        shared bearer token (the reference gates writes behind session
+        auth, app.py:195-254; the token keeps automation working). A
+        server with neither a token nor a user store refuses writes
+        outright rather than running them open."""
+        return self._token_ok(form) or self._session() is not None
+
+    def _admin_ok(self, form: dict | None = None) -> bool:
+        """User CRUD: admin-role session, or the bearer token (the
+        operator who configured the server owns its user store)."""
+        if self._token_ok(form):
+            return True
+        s = self._session()
+        return s is not None and s.get("role") == "admin"
+
     def do_POST(self) -> None:  # noqa: N802 (http.server API)
         parts = [unquote(p) for p in self.path.split("?")[0].split("/") if p]
         try:
             body = self._read_body()
+            if body is None:
+                return  # 413 already sent
             ctype = (self.headers.get("Content-Type") or "").split(";")[0]
             # urllib and curl default the content type to urlencoded even
             # for JSON bodies — sniff the payload, don't trust the header
@@ -264,6 +518,14 @@ class DashboardHandler(BaseHTTPRequestHandler):
                 if ctype == "application/x-www-form-urlencoded"
                 and body and not looks_json else None
             )
+            if parts == ["login"]:
+                return self._login(body, form)
+            if parts == ["logout"]:
+                return self._logout()
+            if len(parts) == 3 and parts[:2] == ["api", "users"]:
+                if not self._admin_ok(form):
+                    return self._json_code({"error": "admin required"}, 401)
+                return self._users_crud(parts[2], body, form)
             if not self._authorized(form):
                 return self._json_code(
                     {"error": "missing or bad auth token"}, 401
@@ -373,9 +635,160 @@ class DashboardHandler(BaseHTTPRequestHandler):
                                       platform)
         self._json({"name": name, "pid": pid, "started": True})
 
+    # ---- sessions + user CRUD (app.py:195-254, database.py:54-120) ------
+
+    def _field(self, body: bytes, form: dict | None, key: str) -> str:
+        if form is not None:
+            vals = form.get(key)
+            return vals[0] if vals else ""
+        try:
+            val = json.loads(body.decode() or "{}").get(key, "")
+            return val if isinstance(val, str) else ""
+        except ValueError:
+            return ""
+
+    def _login(self, body: bytes, form: dict | None) -> None:
+        if self.users is None:
+            return self._json_code({"error": "no user store configured"}, 404)
+        user = self._field(body, form, "user")
+        password = self._field(body, form, "password")
+        role = self.users.verify(user, password)
+        if role is None:
+            return self._send(
+                _page("login failed",
+                      "<p>bad username or password</p>"
+                      "<p><a href='/login'>try again</a></p>"),
+                code=401,
+            )
+        token = self.sessions.create(user, role)
+        self.send_response(303)
+        self.send_header("Location", "/")
+        self.send_header(
+            "Set-Cookie",
+            f"p2pfl_session={token}; HttpOnly; SameSite=Strict; Path=/",
+        )
+        self.end_headers()
+
+    def _logout(self) -> None:
+        self.sessions.drop(self._session_token())
+        self.send_response(303)
+        self.send_header("Location", "/")
+        self.send_header(
+            "Set-Cookie",
+            "p2pfl_session=; Max-Age=0; HttpOnly; SameSite=Strict; Path=/",
+        )
+        self.end_headers()
+
+    def _users_crud(self, action: str, body: bytes,
+                    form: dict | None) -> None:
+        if self.users is None:
+            return self._json_code({"error": "no user store configured"}, 404)
+        user = self._field(body, form, "user")
+        if action == "add":
+            password = self._field(body, form, "password")
+            role = self._field(body, form, "role") or "user"
+            try:
+                self.users.add(user, password, role)
+            except ValueError as e:
+                return self._json_code({"error": str(e)}, 400)
+            # a credential/role change invalidates the user's live
+            # sessions — the next request must authenticate freshly
+            self.sessions.drop_user(user)
+            if form is not None:
+                self.send_response(303)
+                self.send_header("Location", "/admin/users")
+                self.end_headers()
+                return
+            return self._json({"user": user, "role": role, "added": True})
+        if action == "remove":
+            removed = self.users.remove(user)
+            self.sessions.drop_user(user)  # no 12h ghost write access
+            if form is not None:
+                self.send_response(303)
+                self.send_header("Location", "/admin/users")
+                self.end_headers()
+                return
+            return self._json({"user": user, "removed": removed})
+        self._json_code({"error": f"unknown user action {action!r}"}, 404)
+
+    def _login_page(self) -> None:
+        if self.users is None:
+            body = ("<p>no user store configured — start the dashboard "
+                    "with <code>--users users.json</code>; the API token "
+                    "still authenticates automation</p>")
+        else:
+            body = (
+                "<form method='post' action='/login'>"
+                "<p><label>user <input name='user'></label></p>"
+                "<p><label>password <input name='password' "
+                "type='password'></label></p>"
+                "<p><button>log in</button></p></form>"
+            )
+        self._send(_page("login", body))
+
+    def _admin_users_page(self) -> None:
+        if self.users is None:
+            return self._send(
+                _page("user administration",
+                      "<p>no user store configured (--users)</p>"),
+                code=404,
+            )
+        if not self._admin_ok():
+            return self._send(
+                _page("forbidden",
+                      "<p>admin login required — <a href='/login'>log in"
+                      "</a></p>"),
+                code=401,
+            )
+        rows = "".join(
+            f"<tr><td>{html.escape(u)}</td><td>{html.escape(r)}</td>"
+            f"<td><form method='post' action='/api/users/remove' "
+            f"style='margin:0'><input type='hidden' name='user' "
+            f"value='{html.escape(u, quote=True)}'>"
+            f"<button>remove</button></form></td></tr>"
+            for u, r in self.users.list().items()
+        )
+        body = (
+            f"<table><tr><th>USER</th><th>ROLE</th><th></th></tr>{rows}"
+            "</table><h3>add / update user</h3>"
+            "<form method='post' action='/api/users/add'>"
+            "<label>user <input name='user'></label> "
+            "<label>password <input name='password' type='password'>"
+            "</label> <label>role <select name='role'>"
+            "<option>user</option><option>admin</option></select></label> "
+            "<button>save</button></form>"
+        )
+        self._send(_page("user administration", body))
+
+    def _charts(self, name: str) -> None:
+        """Per-node scalar curves from metrics.jsonl (the reference's
+        TensorBoard statistics view, app.py:562-583)."""
+        safe = self._safe_child(name)
+        if safe is None or not safe.is_dir():
+            return self._send(_page("not found", "<p>404</p>"), code=404)
+        records = tail_metrics(self.root, name, n=5000)
+        charts = "".join(
+            _svg_chart(metric, series)
+            for metric, series in sorted(_metric_series(records).items())
+        )
+        body = (
+            charts or "<p>no metrics recorded yet</p>"
+        ) + (
+            f"<p><a href='/scenario/{html.escape(name)}'>back</a> | "
+            f"<a href='/api/metrics/{html.escape(name)}'>table view "
+            "(JSON)</a></p>"
+        )
+        self._send(_page(f"charts — {html.escape(name)}", body, refresh=10))
+
     def _route(self, parts: list[str]) -> None:
         if not parts:
             return self._index()
+        if parts == ["login"]:
+            return self._login_page()
+        if parts == ["admin", "users"]:
+            return self._admin_users_page()
+        if len(parts) == 2 and parts[0] == "charts":
+            return self._charts(parts[1])
         if parts[0] == "api":
             if len(parts) == 2 and parts[1] == "scenarios":
                 return self._json(list_scenarios(self.root))
@@ -414,12 +827,29 @@ class DashboardHandler(BaseHTTPRequestHandler):
                 n=html.escape(s["name"]), c=s["n_nodes"],
                 r="running" if s["running"] else "stopped",
                 d=html.escape(self.deployments.state(s["name"]) or "-"),
-                m="yes" if s["has_metrics"] else "-",
+                m=("<a href='/charts/%s'>charts</a>" % html.escape(s["name"])
+                   if s["has_metrics"] else "-"),
             )
             for s in list_scenarios(self.root)
         )
+        session = self._session()
+        if session is not None:
+            who = (
+                f"logged in as {html.escape(session['user'])} "
+                f"({html.escape(session['role'])}) "
+                "<form method='post' action='/logout' "
+                "style='display:inline;margin:0'><button>log out</button>"
+                "</form>"
+                + (" | <a href='/admin/users'>users</a>"
+                   if session["role"] == "admin" else "")
+            )
+        elif self.users is not None:
+            who = "<a href='/login'>log in</a>"
+        else:
+            who = ""
         body = (
-            "<p><a href='/designer'>deploy a new scenario</a></p>"
+            (f"<p>{who}</p>" if who else "")
+            + "<p><a href='/designer'>deploy a new scenario</a></p>"
             "<table><tr><th>SCENARIO</th><th>NODES</th><th>STATE</th>"
             f"<th>DEPLOYMENT</th><th>METRICS</th></tr>{rows}</table>"
         )
@@ -470,7 +900,8 @@ class DashboardHandler(BaseHTTPRequestHandler):
         )
         body = (
             inner
-            + f"<p><a href='/api/metrics/{html.escape(name)}'>metrics</a>"
+            + f"<p><a href='/charts/{html.escape(name)}'>charts</a>"
+            + f" | <a href='/api/metrics/{html.escape(name)}'>metrics</a>"
             + f" | <a href='/api/download/{html.escape(name)}'>download zip</a>"
             + (f" | logs: {links}" if links else "")
             + "</p>"
@@ -574,14 +1005,22 @@ class DashboardHandler(BaseHTTPRequestHandler):
 
 def make_server(log_root: str | pathlib.Path, port: int = 8666,
                 host: str = "127.0.0.1",
-                token: str | None = None) -> ThreadingHTTPServer:
-    """``token`` enables the write routes (deploy/stop/remove/reload);
-    ``None`` leaves the dashboard read-only."""
+                token: str | None = None,
+                users=None) -> ThreadingHTTPServer:
+    """``token`` enables the write routes (deploy/stop/remove/reload)
+    for API clients; ``users`` (a ``UserStore`` or a path to one)
+    enables browser login/session auth; with neither, the dashboard is
+    read-only."""
+    from p2pfl_tpu.users import UserStore
+
     root = pathlib.Path(log_root)
     root.mkdir(parents=True, exist_ok=True)
+    if users is not None and not isinstance(users, UserStore):
+        users = UserStore(users)
     handler = type(
         "BoundHandler", (DashboardHandler,),
-        {"root": root, "token": token, "deployments": Deployments()},
+        {"root": root, "token": token, "deployments": Deployments(),
+         "users": users, "sessions": Sessions()},
     )
     return ThreadingHTTPServer((host, port), handler)
 
@@ -594,11 +1033,36 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--token", default=None,
                     help="shared auth token for the write routes; "
                          "omitted = a fresh one is minted and printed")
+    ap.add_argument("--users", default=None, metavar="USERS_JSON",
+                    help="user store enabling browser login/session auth")
+    ap.add_argument("--add-user", default=None, metavar="NAME",
+                    help="with --users: add/update this user in the "
+                         "store (prompts for the password) and exit")
+    ap.add_argument("--password", default=None,
+                    help="password for --add-user (omitted = prompt)")
+    ap.add_argument("--role", default="user", choices=["user", "admin"],
+                    help="role for --add-user")
     ap.add_argument("--read-only", action="store_true",
                     help="disable the write routes entirely")
     args = ap.parse_args(argv)
+
+    if args.add_user:
+        from p2pfl_tpu.users import UserStore
+
+        if not args.users:
+            ap.error("--add-user requires --users")
+        password = args.password
+        if password is None:
+            import getpass
+
+            password = getpass.getpass(f"password for {args.add_user}: ")
+        UserStore(args.users).add(args.add_user, password, args.role)
+        print(f"user {args.add_user!r} ({args.role}) saved to {args.users}")
+        return 0
+
     token = None if args.read_only else (args.token or secrets.token_urlsafe(24))
-    server = make_server(args.log_root, args.port, args.host, token=token)
+    server = make_server(args.log_root, args.port, args.host, token=token,
+                         users=None if args.read_only else args.users)
     print(f"dashboard on http://{args.host}:{server.server_address[1]}/")
     if token is not None and not args.token:
         print(f"write-route auth token: {token}")

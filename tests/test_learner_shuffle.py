@@ -1,0 +1,86 @@
+"""The one-hot-matmul epoch shuffle's exactness contract (ADVICE r3):
+``_shuffle(x, perm)`` must equal ``x[perm]`` BIT-exactly on the float
+path — a toolchain change to the HIGHEST-precision decomposition would
+otherwise silently corrupt per-epoch data. Also pins the documented
+fallbacks (int dtype, >4096 rows) and the finite-input precondition's
+failure shape."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from p2pfl_tpu.learning.learner import make_step_fns
+from p2pfl_tpu.models import get_model
+
+
+def _get_shuffle():
+    """The _shuffle closure out of make_step_fns, via the epoch path's
+    own module namespace (it is a nested function, so grab it from the
+    test-visible seam: recreate the identical logic is NOT ok — the
+    test must pin the shipped code)."""
+    fns = make_step_fns(get_model("mnist-mlp"), batch_size=8)
+    # train_epochs closes over train_one_epoch which closes over
+    # _shuffle; walk the closure cells to find it
+    def find(fn, name, seen=None):
+        seen = seen if seen is not None else set()
+        if fn in seen or not getattr(fn, "__closure__", None):
+            return None
+        seen.add(fn)
+        for cell in fn.__closure__:
+            try:
+                val = cell.cell_contents
+            except ValueError:
+                continue
+            if getattr(val, "__name__", "") == name:
+                return val
+            if callable(val):
+                got = find(val, name, seen)
+                if got is not None:
+                    return got
+        return None
+
+    shuffle = find(fns.train_epochs, "_shuffle")
+    assert shuffle is not None, "could not locate _shuffle closure"
+    return shuffle
+
+
+def test_float_shuffle_bit_exact():
+    shuffle = _get_shuffle()
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(337, 28, 28, 1)).astype(np.float32))
+    perm = jax.random.permutation(jax.random.PRNGKey(1), 337)
+    got = jax.jit(shuffle)(x, perm)
+    want = x[perm]
+    # BIT-exact, not allclose: the claim is exactness
+    assert jnp.array_equal(got, want)
+    assert got.dtype == want.dtype
+
+
+def test_int_and_large_inputs_take_gather():
+    shuffle = _get_shuffle()
+    perm = jax.random.permutation(jax.random.PRNGKey(2), 64)
+    y = jnp.arange(64, dtype=jnp.int32)
+    assert jnp.array_equal(shuffle(y, perm), y[perm])
+    # > 4096 rows: documented gather fallback (no [n, n] one-hot)
+    big = jnp.ones((5000, 4), jnp.float32)
+    perm_big = jax.random.permutation(jax.random.PRNGKey(3), 5000)
+    assert jnp.array_equal(shuffle(big, perm_big), big[perm_big])
+
+
+def test_nonfinite_containment_is_the_gathers_not_the_matmuls():
+    """The documented precondition: the matmul path smears one NaN row
+    across every output row's column (0.0 * NaN = NaN), the gather
+    keeps it local. This test is the tripwire that the docstring's
+    containment analysis stays true — if the matmul path ever starts
+    containing NaNs (e.g. an XLA select-based rewrite), the
+    precondition note should be revisited rather than silently relied
+    on."""
+    shuffle = _get_shuffle()
+    x = jnp.ones((8, 4), jnp.float32).at[3, 2].set(jnp.nan)
+    perm = jnp.arange(8)[::-1]
+    got = shuffle(x, perm)
+    gathered = x[perm]
+    # gather: exactly one NaN
+    assert int(jnp.sum(jnp.isnan(gathered))) == 1
+    # matmul path: the NaN smears down its column (documented behavior)
+    assert int(jnp.sum(jnp.isnan(got))) >= 1

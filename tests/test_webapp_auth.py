@@ -1,0 +1,248 @@
+"""Dashboard session auth + user CRUD + scalar charts — the last L5
+reference capabilities: login/session gating (webserver/app.py:195-254),
+role-gated user administration (database.py:54-120), and the statistics
+view over per-node scalars (app.py:562-583)."""
+
+import http.cookiejar
+import json
+import threading
+import urllib.error
+import urllib.parse
+import urllib.request
+
+import pytest
+
+from p2pfl_tpu.users import UserStore
+from p2pfl_tpu.utils.metrics import MetricsLogger
+from p2pfl_tpu.webapp import make_server
+
+
+# ---- UserStore ----------------------------------------------------------
+
+
+def test_user_store_roundtrip(tmp_path):
+    store = UserStore(tmp_path / "users.json")
+    store.add("alice", "s3cret", "admin")
+    store.add("bob", "hunter2")
+    assert store.list() == {"alice": "admin", "bob": "user"}
+    assert store.verify("alice", "s3cret") == "admin"
+    assert store.verify("alice", "wrong") is None
+    assert store.verify("nosuch", "x") is None
+    assert store.remove("bob") and not store.remove("bob")
+    assert store.list() == {"alice": "admin"}
+
+
+def test_user_store_rejects_bad_input(tmp_path):
+    store = UserStore(tmp_path / "users.json")
+    with pytest.raises(ValueError):
+        store.add("x", "pw", role="root")
+    with pytest.raises(ValueError):
+        store.add("", "pw")
+    with pytest.raises(ValueError):
+        store.add("x", "")
+
+
+def test_user_store_survives_corrupt_file(tmp_path):
+    path = tmp_path / "users.json"
+    path.write_text("{not json")
+    store = UserStore(path)
+    assert store.verify("x", "y") is None
+    store.add("alice", "pw")
+    assert store.verify("alice", "pw") == "user"
+
+
+# ---- session auth over HTTP ---------------------------------------------
+
+
+class _Browser:
+    """Cookie-keeping client (a logged-in browser)."""
+
+    def __init__(self, base):
+        self.base = base
+        self.jar = http.cookiejar.CookieJar()
+        self.opener = urllib.request.build_opener(
+            urllib.request.HTTPCookieProcessor(self.jar)
+        )
+
+    def get(self, path):
+        try:
+            with self.opener.open(self.base + path, timeout=10) as r:
+                return r.status, r.read().decode()
+        except urllib.error.HTTPError as e:
+            return e.code, e.read().decode()
+
+    def post(self, path, data=None, json_body=None):
+        if json_body is not None:
+            body = json.dumps(json_body).encode()
+            headers = {"Content-Type": "application/json"}
+        else:
+            body = urllib.parse.urlencode(data or {}).encode()
+            headers = {"Content-Type": "application/x-www-form-urlencoded"}
+        req = urllib.request.Request(self.base + path, data=body,
+                                     headers=headers, method="POST")
+        try:
+            with self.opener.open(req, timeout=10) as r:
+                return r.status, r.read().decode()
+        except urllib.error.HTTPError as e:
+            return e.code, e.read().decode()
+
+
+@pytest.fixture()
+def auth_server(tmp_path):
+    store = UserStore(tmp_path / "users.json")
+    store.add("root", "rootpw", "admin")
+    store.add("viewer", "viewerpw", "user")
+    srv = make_server(tmp_path / "www", port=0, token="apitoken",
+                      users=store)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield f"http://127.0.0.1:{srv.server_address[1]}"
+    srv.shutdown()
+
+
+def test_login_gates_writes(auth_server):
+    b = _Browser(auth_server)
+    # anonymous: writes refused
+    code, _ = b.post("/api/scenario/x/stop")
+    assert code == 401
+    # bad password: no cookie, still refused
+    code, _ = b.post("/login", {"user": "root", "password": "wrong"})
+    assert code == 401
+    code, _ = b.post("/api/scenario/x/stop")
+    assert code == 401
+    # good login: 303 home, session cookie set, write allowed
+    code, _ = b.post("/login", {"user": "root", "password": "rootpw"})
+    assert code == 200  # opener follows the 303 to /
+    assert any(c.name == "p2pfl_session" for c in b.jar)
+    code, body = b.post("/api/scenario/x/stop")
+    assert code == 200 and json.loads(body)["stopped"] is False
+    # index shows the logged-in identity
+    _, page = b.get("/")
+    assert "logged in as root" in page and "admin" in page
+    # logout drops the session
+    code, _ = b.post("/logout")
+    assert code == 200
+    code, _ = b.post("/api/scenario/x/stop")
+    assert code == 401
+
+
+def test_role_gating_on_user_crud(auth_server):
+    viewer = _Browser(auth_server)
+    viewer.post("/login", {"user": "viewer", "password": "viewerpw"})
+    # non-admin session: deploy-class writes allowed, user CRUD refused
+    code, _ = viewer.post("/api/scenario/x/stop")
+    assert code == 200
+    code, _ = viewer.post("/api/users/add",
+                          json_body={"user": "evil", "password": "pw",
+                                     "role": "admin"})
+    assert code == 401
+    code, _ = viewer.get("/admin/users")
+    assert code == 401
+
+    admin = _Browser(auth_server)
+    admin.post("/login", {"user": "root", "password": "rootpw"})
+    code, page = admin.get("/admin/users")
+    assert code == 200 and "viewer" in page
+    code, body = admin.post("/api/users/add",
+                            json_body={"user": "carol", "password": "pw",
+                                       "role": "user"})
+    assert code == 200 and json.loads(body)["added"]
+    carol = _Browser(auth_server)
+    code, _ = carol.post("/login", {"user": "carol", "password": "pw"})
+    assert code == 200
+    code, body = admin.post("/api/users/remove",
+                            json_body={"user": "carol"})
+    assert code == 200 and json.loads(body)["removed"]
+    # removal kills carol's LIVE session too — no 12h ghost access
+    code, _ = carol.post("/api/scenario/x/stop")
+    assert code == 401
+    # the bearer token still works for automation (admin-equivalent)
+    req = urllib.request.Request(
+        auth_server + "/api/users/add",
+        data=json.dumps({"user": "bot", "password": "pw"}).encode(),
+        headers={"Authorization": "Bearer apitoken"}, method="POST")
+    with urllib.request.urlopen(req, timeout=10) as r:
+        assert r.status == 200
+
+
+def test_oversized_body_rejected(auth_server):
+    """ADVICE r3: a >1 MiB body must 413 (and close) without reading —
+    not parse a truncated prefix into an opaque 500 and leave the
+    unread bytes corrupting the next pipelined request. Raw socket:
+    the server must answer from the Content-Length header alone."""
+    import socket
+
+    host, port = auth_server.split("//")[1].split(":")
+    with socket.create_connection((host, int(port)), timeout=10) as s:
+        s.sendall(
+            b"POST /api/scenario/run HTTP/1.1\r\n"
+            b"Host: x\r\nAuthorization: Bearer apitoken\r\n"
+            b"Content-Length: %d\r\n\r\n" % ((1 << 20) + 1)
+        )
+        reply = s.recv(4096).decode()
+        assert reply.startswith("HTTP/1.")
+        assert " 413 " in reply.split("\r\n")[0]
+        # the connection must CLOSE (no pipelined-corruption window):
+        # the server never reads our body, so EOF must arrive without
+        # us sending a single body byte
+        s.settimeout(10)
+        while s.recv(4096):
+            pass
+
+
+# ---- scalar charts ------------------------------------------------------
+
+
+def test_charts_page_renders_series(tmp_path):
+    ml = MetricsLogger(tmp_path, "run1")
+    for step in range(5):
+        ml.log_metrics({"Train/loss": 1.0 / (step + 1)}, step=step,
+                       round=step, node=0)
+        ml.log_metrics({"Train/loss": 2.0 / (step + 1)}, step=step,
+                       round=step, node=1)
+        ml.log_metrics({"Test/accuracy": 0.5 + 0.1 * step}, step=step,
+                       round=step)  # federation-level
+    ml.close()
+    srv = make_server(tmp_path, port=0)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        b = _Browser(f"http://127.0.0.1:{srv.server_address[1]}")
+        code, page = b.get("/charts/run1")
+        assert code == 200
+        assert "<svg" in page and "Train/loss" in page
+        assert "Test/accuracy" in page
+        assert "node 0" in page and "node 1" in page and "federation" in page
+        # scenario page links to the charts
+        code, page = b.get("/scenario/run1")
+        assert code == 200 and "/charts/run1" in page
+        # traversal-safe + 404 on unknown
+        code, _ = b.get("/charts/nosuch")
+        assert code == 404
+    finally:
+        srv.shutdown()
+
+
+def test_charts_many_nodes_fold_to_highlight(tmp_path):
+    """> 8 node series fold to muted lines + highlighted federation
+    mean (identity via hover), never a 9th generated hue."""
+    from p2pfl_tpu.webapp import _MAX_COLORED_SERIES, _metric_series, _svg_chart
+
+    ml = MetricsLogger(tmp_path, "big")
+    for node in range(12):
+        for step in range(3):
+            ml.log_metrics({"loss": float(node + step)}, step=step,
+                           node=node)
+    for step in range(3):
+        ml.log_metrics({"loss": float(step)}, step=step)
+    ml.close()
+    series = _metric_series(
+        [json.loads(line) for line in
+         (tmp_path / "big" / "metrics.jsonl").read_text().splitlines()]
+    )["loss"]
+    assert len(series) == 13 > _MAX_COLORED_SERIES
+    svg = _svg_chart("loss", series)
+    assert "12 nodes" in svg and "federation" in svg
+    # the muted fold means at most 2 stroke colors besides chrome
+    strokes = {part.split("'")[0] for part in svg.split("stroke='")[1:]}
+    assert len(strokes - {"none", "#2c2c2a", "#383835"}) <= 2

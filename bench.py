@@ -6,13 +6,13 @@ Primary metric (BASELINE.json north star): steady-state wall-clock per
 federated round for a **64-node FEMNIST-CNN** federation (ring
 topology, FedAvg, 1 local epoch over a genuinely-750-sample/node
 surrogate shard — 675 train rows after the 10% val split, which
-BENCH_r01/r02 silently capped at 338 (surrogate size); batch 224, lr
-0.05 — swept {64..672}x{0.05..0.15}: large batches cut both the
-HBM-bound weight-state passes and per-step launch overhead, batch
-shape matters (224 = 7x32 tiles well where 135/150 lower ~35% slower),
-and 224@0.05 wins seconds-to-80% outright — see docs/perf.md) on the
-available TPU device(s) — one vmapped SPMD program; on a pod slice the
-same program shards 1 node/chip.
+BENCH_r01/r02 silently capped at 338 (surrogate size); batch 336, lr
+0.05, bf16 momentum accumulator — the round-4 re-sweep after the
+PatchConv conv1 fix shifted the optimum up from round 3's 224; same
+672 samples/epoch in 2 steps instead of 3, cutting the HBM-bound
+weight-state passes — see docs/perf.md) on the available TPU
+device(s) — one vmapped SPMD program; on a pod slice the same program
+shards 1 node/chip.
 
 Timing method: 10 rounds chained per host sync. The axon tunnel to the
 bench chip costs ~0.11 s per dispatch+fetch (measured: a null program
@@ -33,7 +33,10 @@ a measured run; the ratio is floor / measured.
 Extra keys in the same JSON line:
 - ``mfu`` / ``achieved_tflops``: hardware utilization of the round
   program (XLA cost-analysis FLOPs over measured wall-clock, against
-  the chip's bf16 peak);
+  the chip's bf16 peak). NOTE: rounds 1-3 were inflated ~1.7x by
+  XLA's grouped-conv FLOP overcount on conv1; the round-4 PatchConv
+  model lowers to correctly-counted matmuls, so current values are
+  honest and NOT directly comparable to BENCH_r03's (docs/perf.md §4);
 - ``rounds_to_80pct`` / ``seconds_to_80pct``: rounds and wall-clock for
   the 64-node federation to reach 80% mean test accuracy, measured by
   a single-dispatch trajectory program with an in-round eval on the
@@ -105,8 +108,9 @@ def _peak_flops(device) -> float | None:
 
 def _build(n: int, *, dataset="femnist", model="femnist-cnn",
            topology="ring", aggregator=None, partition="iid",
-           samples_per_node=750, batch_size=224, learning_rate=0.05,
-           optimizer="sgd", exchange_dtype="bf16", seed=0,
+           samples_per_node=750, batch_size=336, learning_rate=0.05,
+           optimizer="sgd", momentum_dtype=None,
+           exchange_dtype="bf16", seed=0,
            model_kwargs=None, shared_aggregate=False):
     """Assemble one federated configuration into compiled programs.
 
@@ -140,6 +144,7 @@ def _build(n: int, *, dataset="femnist", model="femnist-cnn",
     x, y, smask, nsamp = ds.stacked()
     fns = make_step_fns(get_model(model, **(model_kwargs or {})),
                         optimizer=optimizer, learning_rate=learning_rate,
+                        momentum_dtype=momentum_dtype,
                         batch_size=batch_size)
     topo_kw = {"seed": seed} if topology in ("ring", "random") else {}
     topo = generate_topology(topology, n, **topo_kw)
@@ -176,6 +181,7 @@ def _build(n: int, *, dataset="femnist", model="femnist-cnn",
         "config": dict(dataset=dataset, model=model, topology=topology,
                        partition=partition, batch_size=batch_size,
                        learning_rate=learning_rate, optimizer=optimizer,
+                       momentum_dtype=momentum_dtype,
                        samples_per_node=samples_per_node,
                        exchange_dtype=exchange_dtype,
                        shared_aggregate=shared_aggregate,
@@ -247,6 +253,7 @@ def _probe_flops(run) -> float | None:
                    batch_size=run["used"],
                    learning_rate=cfg["learning_rate"],
                    optimizer=cfg["optimizer"],
+                   momentum_dtype=cfg["momentum_dtype"],
                    exchange_dtype=cfg["exchange_dtype"],
                    model_kwargs=cfg["model_kwargs"])
     return _round_flops(probe["round_fn"], probe["fed"], probe["fargs"])
@@ -613,7 +620,7 @@ def _phase_headline() -> None:
     importance order so a mid-phase kill keeps the earlier ones."""
     import jax
 
-    run = _build(64)
+    run = _build(64, momentum_dtype="bf16")
     round_s = _time_chained(run)
     direct = _round_flops(run["round_fn"], run["fed"], run["fargs"])
     probe = _probe_flops(run)
@@ -708,6 +715,13 @@ def _stream_child(fn_name: str, deadline: float, on_part) -> str | None:
     threading.Thread(target=_read_out, daemon=True).start()
     threading.Thread(target=_read_err, daemon=True).start()
 
+    def _feed(line: str) -> None:
+        if line.startswith(_PART_TAG):
+            try:
+                on_part(json.loads(line[len(_PART_TAG):]))
+            except (json.JSONDecodeError, TypeError):
+                pass
+
     killed = False
     while True:
         remaining = deadline - time.monotonic()
@@ -721,11 +735,16 @@ def _stream_child(fn_name: str, deadline: float, on_part) -> str | None:
             continue
         if line is None:
             break
-        if line.startswith(_PART_TAG):
-            try:
-                on_part(json.loads(line[len(_PART_TAG):]))
-            except (json.JSONDecodeError, TypeError):
-                pass
+        _feed(line)
+    # drain parts already enqueued at kill/EOF time — a part printed
+    # just before the deadline is measured data, keep it
+    while True:
+        try:
+            line = q.get_nowait()
+        except queue.Empty:
+            break
+        if line is not None:
+            _feed(line)
     try:
         proc.wait(timeout=10)
     except subprocess.TimeoutExpired:

@@ -67,6 +67,8 @@ class TrainingConfig:
     learning_rate: float = 0.1
     momentum: float = 0.9
     weight_decay: float = 0.0
+    momentum_dtype: str | None = None  # "bf16" halves optimizer-state
+    # HBM traffic (docs/perf.md §2 regime 1); None keeps f32
     eval_every: int = 1  # rounds between federated evaluations
 
 

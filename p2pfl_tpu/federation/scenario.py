@@ -85,6 +85,7 @@ class Scenario(Observable):
             learning_rate=config.training.learning_rate,
             momentum=config.training.momentum,
             weight_decay=config.training.weight_decay,
+            momentum_dtype=config.training.momentum_dtype,
             batch_size=config.data.batch_size,
         )
         self.topology = generate_topology(

@@ -49,11 +49,21 @@ class TrainState(struct.PyTreeNode):
 
 
 def make_optimizer(name: str = "sgd", learning_rate: float = 0.1,
-                   momentum: float = 0.9, weight_decay: float = 0.0):
-    """Optimizer factory (TrainingConfig.optimizer)."""
+                   momentum: float = 0.9, weight_decay: float = 0.0,
+                   momentum_dtype: str | None = None):
+    """Optimizer factory (TrainingConfig.optimizer).
+
+    ``momentum_dtype="bf16"`` stores the SGD momentum accumulator in
+    bfloat16: each training step streams every node's full optimizer
+    state through HBM (docs/perf.md §2 regime 1), so halving the
+    accumulator bytes buys measured round time (~5% on the north-star
+    config) for a tiny, SGD-tolerated precision loss. f32 default.
+    """
     name = name.lower()
+    acc_dt = jnp.bfloat16 if momentum_dtype in ("bf16", "bfloat16") else None
     if name == "sgd":
-        tx = optax.sgd(learning_rate, momentum=momentum)
+        tx = optax.sgd(learning_rate, momentum=momentum,
+                       accumulator_dtype=acc_dt)
     elif name == "adam":
         tx = optax.adam(learning_rate)
     elif name == "adamw":
@@ -84,6 +94,7 @@ def make_step_fns(
     learning_rate: float = 0.1,
     momentum: float = 0.9,
     weight_decay: float = 0.0,
+    momentum_dtype: str | None = None,
     batch_size: int = 32,
     eval_batch_size: int = 512,
 ) -> StepFns:
@@ -107,6 +118,7 @@ def make_step_fns(
     tx = make_optimizer(
         optimizer, learning_rate, momentum,
         weight_decay if optimizer.lower() == "adamw" else 0.0,
+        momentum_dtype=momentum_dtype,
     )
 
     def init(rng, sample_x) -> TrainState:
@@ -291,11 +303,12 @@ class SharedTrainer:
 
     def __init__(self, model, objective="classification", optimizer="sgd",
                  learning_rate=0.1, momentum=0.9, weight_decay=0.0,
-                 batch_size=32):
+                 momentum_dtype=None, batch_size=32):
         self.fns = make_step_fns(
             model, objective=objective, optimizer=optimizer,
             learning_rate=learning_rate, momentum=momentum,
-            weight_decay=weight_decay, batch_size=batch_size,
+            weight_decay=weight_decay, momentum_dtype=momentum_dtype,
+            batch_size=batch_size,
         )
         self.train_jit = jax.jit(self.fns.train_epochs,
                                  static_argnames=("epochs",))
@@ -315,7 +328,8 @@ class JaxLearner(NodeLearner):
 
     def __init__(self, model=None, data=None, objective="classification",
                  optimizer="sgd", learning_rate=0.1, momentum=0.9,
-                 weight_decay=0.0, batch_size=32, seed=0, logger=None,
+                 weight_decay=0.0, momentum_dtype=None, batch_size=32,
+                 seed=0, logger=None,
                  trainer: SharedTrainer | None = None):
         self.model = model
         self.data = data
@@ -324,6 +338,7 @@ class JaxLearner(NodeLearner):
         self.learning_rate = learning_rate
         self.momentum = momentum
         self.weight_decay = weight_decay
+        self.momentum_dtype = momentum_dtype
         self.batch_size = batch_size
         self.seed = seed
         self.logger = logger
@@ -357,6 +372,7 @@ class JaxLearner(NodeLearner):
             self.model, objective=self.objective,
             optimizer=self.optimizer_name, learning_rate=self.learning_rate,
             momentum=self.momentum, weight_decay=self.weight_decay,
+            momentum_dtype=self.momentum_dtype,
             batch_size=self.batch_size,
         )
         self._train_jit = jax.jit(self.fns.train_epochs,

@@ -10,9 +10,58 @@ bfloat16 compute.
 from __future__ import annotations
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 
 from p2pfl_tpu.models.base import register_model
+
+#: contraction size (C_in * k * k) at or below which a conv runs as
+#: patches + matmul instead of lax.conv. The federation vmaps per-node
+#: conv weights, which XLA lowers to feature_group_count=n_nodes
+#: grouped convolutions; for tiny per-group contractions (conv1 of the
+#: LEAF CNN: C_in=1, 5x5 -> 25) that lowering runs at <1% of the MXU
+#: (measured: 13.2 ms fwd + 22 ms bwd vs 6.9 + 12 for the patches
+#: form at n=64, b=224 — scripts/exp_op_breakdown.py). Patches cost a
+#: contraction-fold memory inflation, so only small contractions
+#: qualify (conv2's 800-wide patches sank whole-model im2col,
+#: scripts/exp_im2col.py).
+PATCH_CONV_MAX_CONTRACTION = 64
+
+
+class PatchConv(nn.Module):
+    """nn.Conv-compatible conv expressed as im2col patches + matmul.
+
+    Same parameter tree as ``nn.Conv`` (``kernel`` [kh, kw, cin, f] +
+    ``bias`` [f]) so checkpoints, aggregators, and param-shape checks
+    see no difference; only the lowering changes.
+    """
+
+    features: int
+    kernel_size: tuple[int, int]
+    use_bias: bool = True
+    dtype: jnp.dtype = jnp.bfloat16
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        kh, kw = self.kernel_size
+        cin = x.shape[-1]
+        w = self.param("kernel", nn.initializers.lecun_normal(),
+                       (kh, kw, cin, self.features), self.param_dtype)
+        patches = jax.lax.conv_general_dilated_patches(
+            x.astype(self.dtype), (kh, kw), (1, 1), "SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )  # [..., H, W, cin*kh*kw], channel-major patch order
+        # patches order the feature dim as (cin, kh, kw); HWIO kernels
+        # are (kh, kw, cin) -> transpose before flattening to match
+        wf = (w.astype(self.dtype)
+              .transpose(2, 0, 1, 3).reshape(cin * kh * kw, self.features))
+        out = patches @ wf
+        if self.use_bias:
+            b = self.param("bias", nn.initializers.zeros,
+                           (self.features,), self.param_dtype)
+            out = out + b.astype(self.dtype)
+        return out
 
 
 class SmallCNN(nn.Module):
@@ -31,9 +80,18 @@ class SmallCNN(nn.Module):
             x = x[..., None]  # HW → HWC
         x = x.astype(self.dtype)
         k = (self.kernel, self.kernel)
-        for c in self.channels:
-            x = nn.Conv(c, k, padding="SAME", dtype=self.dtype,
-                        param_dtype=self.param_dtype)(x)
+        for i, c in enumerate(self.channels):
+            # explicit name= keeps the param tree keyed Conv_N exactly
+            # as nn.Conv auto-naming did, so pre-PatchConv checkpoints
+            # still resume (the two modules share param shapes)
+            if x.shape[-1] * self.kernel ** 2 <= PATCH_CONV_MAX_CONTRACTION:
+                x = PatchConv(c, k, dtype=self.dtype,
+                              param_dtype=self.param_dtype,
+                              name=f"Conv_{i}")(x)
+            else:
+                x = nn.Conv(c, k, padding="SAME", dtype=self.dtype,
+                            param_dtype=self.param_dtype,
+                            name=f"Conv_{i}")(x)
             x = nn.relu(x)
             x = nn.max_pool(x, (2, 2), strides=(2, 2))
         x = x.reshape((x.shape[0], -1))

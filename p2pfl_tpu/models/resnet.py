@@ -16,6 +16,7 @@ import flax.linen as nn
 import jax.numpy as jnp
 
 from p2pfl_tpu.models.base import register_model
+from p2pfl_tpu.models.cnn import PATCH_CONV_MAX_CONTRACTION, PatchConv
 
 
 def _gn(groups: int, dtype, param_dtype):
@@ -30,8 +31,19 @@ class ConvBlock(nn.Module):
 
     @nn.compact
     def __call__(self, x):
-        x = nn.Conv(self.features, (3, 3), padding="SAME", use_bias=False,
-                    dtype=self.dtype, param_dtype=self.param_dtype)(x)
+        if x.shape[-1] * 9 <= PATCH_CONV_MAX_CONTRACTION:
+            # the RGB stem (contraction 27) under the vmapped
+            # federation lowers to a degenerate grouped conv — same
+            # fix as the LEAF CNN's conv1 (models/cnn.py PatchConv);
+            # name="Conv_0" keeps pre-PatchConv checkpoints loadable
+            x = PatchConv(self.features, (3, 3), use_bias=False,
+                          dtype=self.dtype,
+                          param_dtype=self.param_dtype,
+                          name="Conv_0")(x)
+        else:
+            x = nn.Conv(self.features, (3, 3), padding="SAME",
+                        use_bias=False, dtype=self.dtype,
+                        param_dtype=self.param_dtype)(x)
         x = _gn(min(32, self.features), self.dtype, self.param_dtype)(x)
         x = nn.relu(x)
         if self.pool:

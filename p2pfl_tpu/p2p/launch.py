@@ -73,6 +73,7 @@ async def _run_node(cfg: ScenarioConfig, idx: int, ports: list[int],
         learning_rate=cfg.training.learning_rate,
         momentum=cfg.training.momentum,
         weight_decay=cfg.training.weight_decay,
+        momentum_dtype=cfg.training.momentum_dtype,
         batch_size=cfg.data.batch_size,
         seed=cfg.seed,
     )
@@ -184,6 +185,7 @@ async def _simulate(cfg: ScenarioConfig, timeout: float = 600) -> dict:
         learning_rate=cfg.training.learning_rate,
         momentum=cfg.training.momentum,
         weight_decay=cfg.training.weight_decay,
+        momentum_dtype=cfg.training.momentum_dtype,
         batch_size=cfg.data.batch_size,
     )
     nodes = [

@@ -74,15 +74,19 @@ class UserStore:
         tmp.write_text(json.dumps(data, indent=1))
         os.replace(tmp, self.path)
 
-    def add(self, user: str, password: str, role: str = "user") -> None:
+    def add(self, user: str, password: str, role: str | None = None) -> None:
         """Create or update a user (the reference's add/update rows,
-        database.py:88-112)."""
-        if role not in ROLES:
+        database.py:88-112). ``role=None`` preserves an existing
+        user's role on update (a password reset must not silently
+        demote an admin) and defaults new users to "user"."""
+        if role is not None and role not in ROLES:
             raise ValueError(f"role must be one of {ROLES}")
         if not user or not password:
             raise ValueError("user and password must be non-empty")
         with self._locked():
             data = self._load()
+            if role is None:
+                role = data.get(user, {}).get("role", "user")
             salt = secrets.token_bytes(16)
             data[user] = {
                 "salt": salt.hex(),

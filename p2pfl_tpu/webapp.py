@@ -686,7 +686,9 @@ class DashboardHandler(BaseHTTPRequestHandler):
         user = self._field(body, form, "user")
         if action == "add":
             password = self._field(body, form, "password")
-            role = self._field(body, form, "role") or "user"
+            # omitted role -> None: UserStore preserves an existing
+            # user's role (password reset must not demote an admin)
+            role = self._field(body, form, "role") or None
             try:
                 self.users.add(user, password, role)
             except ValueError as e:
@@ -699,7 +701,9 @@ class DashboardHandler(BaseHTTPRequestHandler):
                 self.send_header("Location", "/admin/users")
                 self.end_headers()
                 return
-            return self._json({"user": user, "role": role, "added": True})
+            return self._json({"user": user,
+                               "role": self.users.list().get(user),
+                               "added": True})
         if action == "remove":
             removed = self.users.remove(user)
             self.sessions.drop_user(user)  # no 12h ghost write access
@@ -755,6 +759,7 @@ class DashboardHandler(BaseHTTPRequestHandler):
             "<label>user <input name='user'></label> "
             "<label>password <input name='password' type='password'>"
             "</label> <label>role <select name='role'>"
+            "<option value=''>(keep existing / user)</option>"
             "<option>user</option><option>admin</option></select></label> "
             "<button>save</button></form>"
         )

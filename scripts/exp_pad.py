@@ -1,0 +1,109 @@
+"""Experiment (round-4, VERDICT #2): channel padding vs the ~20% MXU
+ceiling on the north-star LEAF CNN.
+
+The vmapped per-node convs lower to feature_group_count grouped convs
+whose per-group output channels (32 / 64) underfill the 128-lane MXU
+tile. Padding output channels to the tile boundary and slicing back
+keeps the math identical while trading FLOPs for full tiles — IF the
+sub-tile lowering is worse than proportional, the pad wins.
+
+Variants (all at the headline batch 224, 64 nodes, one full epoch of
+3 scan steps like the real round program):
+- baseline femnist-cnn (32, 64)
+- conv2 padded to 128, sliced to 64 (2x conv2 FLOPs)
+- both convs padded to 128, sliced (4x conv1, 2x conv2 FLOPs)
+- true-wide (32->128 channels, dense input 6272) for scale reference
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+def amortized(fn, sync, k=10, reps=3):
+    import numpy as np
+
+    out = fn()
+    sync(out)
+    times = []
+    for _ in range(reps):
+        t0 = time.monotonic()
+        for _ in range(k):
+            out = fn()
+        sync(out)
+        times.append((time.monotonic() - t0) / k)
+    return float(np.median(times))
+
+
+class PadCNN(nn.Module):
+    """SmallCNN with conv output channels padded to ``pad`` and sliced
+    back to the logical width — mathematically identical to the
+    baseline (the extra channels never reach the next layer)."""
+
+    logical: tuple[int, int] = (32, 64)
+    pads: tuple[int, int] = (32, 128)
+    hidden: int = 2048
+    num_classes: int = 62
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        if x.ndim == 3:
+            x = x[..., None]
+        x = x.astype(self.dtype)
+        for c, p in zip(self.logical, self.pads):
+            x = nn.Conv(max(c, p), (5, 5), padding="SAME", dtype=self.dtype,
+                        param_dtype=jnp.float32)(x)
+            x = x[..., :c]
+            x = nn.relu(x)
+            x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(self.hidden, dtype=self.dtype,
+                     param_dtype=jnp.float32)(x)
+        x = nn.relu(x)
+        x = nn.Dense(self.num_classes, dtype=self.dtype,
+                     param_dtype=jnp.float32)(x)
+        return x.astype(jnp.float32)
+
+
+def main() -> None:
+    from p2pfl_tpu.learning.learner import make_step_fns
+    from p2pfl_tpu.models import get_model
+
+    n, shard, bsz = 64, 672, 224
+    key = jax.random.PRNGKey(0)
+    x = jax.random.normal(key, (n, shard, 28, 28, 1), jnp.float32)
+    y = jnp.zeros((n, shard), jnp.int32)
+    mask = jnp.ones((n, shard), bool)
+
+    def bench(model, tag):
+        fns = make_step_fns(model, learning_rate=0.05, batch_size=bsz)
+        rngs = jnp.stack([jax.random.PRNGKey(0)] * n)
+        states = jax.jit(jax.vmap(fns.init, in_axes=(0, None)))(
+            rngs, x[0, :1])
+        epoch = jax.jit(jax.vmap(
+            lambda st, xs, ys, ms: fns.train_epochs(st, xs, ys, ms, 1)
+        ))
+        t = amortized(lambda: epoch(states, x, y, mask),
+                      lambda o: float(jnp.sum(o[1]["loss"])))
+        print(f"{tag:28s} {t * 1000:8.2f} ms/epoch", flush=True)
+        return t
+
+    base = bench(get_model("femnist-cnn"), "baseline (32,64)")
+    for pads, tag in (((32, 128), "pad conv2 -> 128"),
+                      ((128, 128), "pad both -> 128"),
+                      ((64, 128), "pad conv1->64 conv2->128")):
+        t = bench(PadCNN(pads=pads), tag)
+        print(f"  vs baseline: {base / t:5.2f}x", flush=True)
+
+
+if __name__ == "__main__":
+    main()

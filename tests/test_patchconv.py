@@ -1,0 +1,63 @@
+"""PatchConv (models/cnn.py): the im2col lowering of small-contraction
+convs must be a drop-in for nn.Conv — same parameter tree, same math.
+Round-4 perf work: the vmapped federation's per-node conv1 lowered to
+a degenerate grouped conv at <2% MXU; PatchConv is the fix and this
+pins its equivalence (incl. the patches channel order, which is
+(cin, kh, kw)-major and MUST match the transposed HWIO kernel)."""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import pytest
+
+from p2pfl_tpu.models import get_model
+from p2pfl_tpu.models.cnn import PATCH_CONV_MAX_CONTRACTION, PatchConv
+
+
+@pytest.mark.parametrize("cin,k,feat", [(1, 5, 32), (3, 3, 8), (1, 3, 16)])
+def test_patchconv_matches_nnconv(cin, k, feat):
+    rng = jax.random.PRNGKey(0)
+    x = jax.random.normal(rng, (4, 12, 12, cin), jnp.float32)
+    ref = nn.Conv(feat, (k, k), padding="SAME", dtype=jnp.float32,
+                  param_dtype=jnp.float32)
+    alt = PatchConv(feat, (k, k), dtype=jnp.float32,
+                    param_dtype=jnp.float32)
+    params = ref.init(rng, x)
+    # identical param tree -> checkpoints/aggregators can't tell
+    assert (jax.tree.structure(params)
+            == jax.tree.structure(alt.init(rng, x)))
+    out_ref = ref.apply(params, x)
+    out_alt = alt.apply(params, x)
+    assert jnp.max(jnp.abs(out_ref - out_alt)) < 1e-5
+
+
+def test_femnist_cnn_param_tree_unchanged_by_patchconv():
+    """conv1 (contraction 25) runs as PatchConv but keeps the Conv_0
+    key (explicit name=), so pre-PatchConv checkpoints still load;
+    conv2 (contraction 800) keeps the conv lowering (patches would
+    800x-inflate activations)."""
+    model = get_model("femnist-cnn")
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 28, 28, 1)))
+    names = set(params["params"])
+    assert {"Conv_0", "Conv_1"} <= names, names
+    assert not any(n.startswith("PatchConv") for n in names), names
+    assert params["params"]["Conv_0"]["kernel"].shape == (5, 5, 1, 32)
+    assert 1 * 25 <= PATCH_CONV_MAX_CONTRACTION < 32 * 25
+
+
+def test_patchconv_gradients_match():
+    rng = jax.random.PRNGKey(1)
+    x = jax.random.normal(rng, (2, 8, 8, 1), jnp.float32)
+    ref = nn.Conv(4, (5, 5), padding="SAME", dtype=jnp.float32,
+                  param_dtype=jnp.float32)
+    alt = PatchConv(4, (5, 5), dtype=jnp.float32, param_dtype=jnp.float32)
+    params = ref.init(rng, x)
+
+    def loss(mod, p):
+        return jnp.sum(mod.apply(p, x) ** 2)
+
+    g_ref = jax.grad(lambda p: loss(ref, p))(params)
+    g_alt = jax.grad(lambda p: loss(alt, p))(params)
+    for a, b in zip(jax.tree.leaves(g_ref), jax.tree.leaves(g_alt)):
+        assert jnp.max(jnp.abs(a - b)) < 1e-4

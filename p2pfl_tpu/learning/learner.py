@@ -60,14 +60,26 @@ def make_optimizer(name: str = "sgd", learning_rate: float = 0.1,
     config) for a tiny, SGD-tolerated precision loss. f32 default.
     """
     name = name.lower()
-    acc_dt = jnp.bfloat16 if momentum_dtype in ("bf16", "bfloat16") else None
+    if momentum_dtype in (None, "f32", "float32"):
+        acc_dt = None
+    elif momentum_dtype in ("bf16", "bfloat16"):
+        acc_dt = jnp.bfloat16
+    else:
+        # an unrecognized value silently training in f32 would record
+        # an optimization that never ran (bench config JSON carries
+        # the string) — reject loudly instead
+        raise ValueError(
+            f"momentum_dtype must be None/'f32'/'bf16', got "
+            f"{momentum_dtype!r}"
+        )
     if name == "sgd":
         tx = optax.sgd(learning_rate, momentum=momentum,
                        accumulator_dtype=acc_dt)
     elif name == "adam":
-        tx = optax.adam(learning_rate)
+        tx = optax.adam(learning_rate, mu_dtype=acc_dt)
     elif name == "adamw":
-        tx = optax.adamw(learning_rate, weight_decay=weight_decay)
+        tx = optax.adamw(learning_rate, weight_decay=weight_decay,
+                         mu_dtype=acc_dt)
         return tx
     else:
         raise ValueError(f"unknown optimizer {name!r}")

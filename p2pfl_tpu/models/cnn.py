@@ -39,28 +39,30 @@ class PatchConv(nn.Module):
     features: int
     kernel_size: tuple[int, int]
     use_bias: bool = True
-    dtype: jnp.dtype = jnp.bfloat16
+    dtype: jnp.dtype | None = None  # None = inherit x.dtype (nn.Conv
+    # semantics — a drop-in must not silently downcast f32 inputs)
     param_dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, x):
         kh, kw = self.kernel_size
         cin = x.shape[-1]
+        dtype = self.dtype or x.dtype
         w = self.param("kernel", nn.initializers.lecun_normal(),
                        (kh, kw, cin, self.features), self.param_dtype)
         patches = jax.lax.conv_general_dilated_patches(
-            x.astype(self.dtype), (kh, kw), (1, 1), "SAME",
+            x.astype(dtype), (kh, kw), (1, 1), "SAME",
             dimension_numbers=("NHWC", "HWIO", "NHWC"),
         )  # [..., H, W, cin*kh*kw], channel-major patch order
         # patches order the feature dim as (cin, kh, kw); HWIO kernels
         # are (kh, kw, cin) -> transpose before flattening to match
-        wf = (w.astype(self.dtype)
+        wf = (w.astype(dtype)
               .transpose(2, 0, 1, 3).reshape(cin * kh * kw, self.features))
         out = patches @ wf
         if self.use_bias:
             b = self.param("bias", nn.initializers.zeros,
                            (self.features,), self.param_dtype)
-            out = out + b.astype(self.dtype)
+            out = out + b.astype(dtype)
         return out
 
 

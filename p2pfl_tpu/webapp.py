@@ -1045,8 +1045,9 @@ def main(argv: list[str] | None = None) -> int:
                          "store (prompts for the password) and exit")
     ap.add_argument("--password", default=None,
                     help="password for --add-user (omitted = prompt)")
-    ap.add_argument("--role", default="user", choices=["user", "admin"],
-                    help="role for --add-user")
+    ap.add_argument("--role", default=None, choices=["user", "admin"],
+                    help="role for --add-user; omitted = keep the "
+                         "existing user's role (new users get 'user')")
     ap.add_argument("--read-only", action="store_true",
                     help="disable the write routes entirely")
     args = ap.parse_args(argv)

@@ -5,30 +5,40 @@ two trip counts — the only trustworthy per-op method on the tunneled
 bench chip, docs/perf.md §1) of each layer's forward and backward as
 the vmapped federation runs them: n=64 nodes, batch 224, bf16 compute.
 
-Measured round-4 results (bench chip, TPU v5e, n=64, batch 224;
-probes whose k2/k8 totals sat near the ~110 ms dispatch floor carry
-real noise — treat single-digit values as +-2 ms):
+Measured round-4 results (bench chip, TPU v5e, n=64, batch 224).
+Noise caveat: each probe's k=2/k=8 totals sit near the ~110 ms
+dispatch floor, so single-digit values carry +-3 ms run-to-run
+scatter — the END-TO-END A/B (209 -> 165 ms/epoch, below) is the
+ground truth; these attribute it:
 
-    conv1 fwd (grouped, Cin=1)    13.2 ms   (~1.4% of bf16 peak!)
-    conv1 dgrad (grouped)          4.1 ms
-    conv1 wgrad (grouped)         18.0 ms
-    conv2 fwd (grouped, Cin=32)    3.6 ms   (~40% of peak)
-    conv2 dgrad / wgrad            3.4 / <2 ms
-    dense1 fwd                     1.8 ms
-    conv1 fwd im2col               6.9 ms
-    conv1 im2col dx+dw            11.9 ms   (vs 22.1 grouped)
-    conv1 fwd shift-MAC           11.6 ms   (no win)
+    conv1 fwd (grouped, Cin=1)    ~13.5 ms  (~1.3% of bf16 peak!)
+    conv1 fwd im2col               ~7.0 ms  (~2x faster)
+    conv1 fwd shift-MAC           ~10-12 ms (no win)
+    conv1 wgrad (grouped)          ~4.8 ms  (cotangent carried, fwd
+                                             excluded — an earlier
+                                             version double-counted)
+    conv1 dgrad (grouped)          ~2.7-4 ms (NOT run by the real
+                                             program: first layer)
+    conv2 fwd (grouped, Cin=32)    ~3.6-10 ms
+    conv2 dgrad / wgrad            ~0.5-3.4 / ~7.6 ms
+    dense1 fwd                     ~1.6 ms
+    conv1 im2col dx+dw            ~18.5 ms  (dx dominates: the
+                                             patches-transpose
+                                             scatter-add — also NOT
+                                             run by the real program)
 
-conv1 under the grouped lowering costs ~35 ms of the ~65 ms step —
-more than half. The federation's vmapped per-node conv weights lower
-to feature_group_count=64 grouped convolutions; with Cin=1 each group
-contracts only 25 — a degenerate shape whose grouped-conv lowering
-barely uses the MXU. conv2's groups contract 800 and are fine. The
-fix (models/cnn.py PatchConv): express small-contraction convs as
-conv_general_dilated_patches + matmul, which XLA maps to a well-tiled
-batched GEMM — measured 209 -> 165 ms/epoch end-to-end (1.27x).
-Whole-model im2col loses (conv2's patches are an 800-wide
-materialization, exp_im2col.py); the win is im2col for conv1 ONLY.
+conv1 under the grouped lowering costs ~18 ms of the ~65 ms step
+(fwd + wgrad; no first-layer dx). The federation's vmapped per-node
+conv weights lower to feature_group_count=64 grouped convolutions;
+with Cin=1 each group contracts only 25 — a degenerate shape whose
+grouped-conv lowering barely uses the MXU. conv2's groups contract
+800 and are fine. The fix (models/cnn.py PatchConv): express
+small-contraction convs as conv_general_dilated_patches + matmul,
+which XLA maps to a well-tiled batched GEMM — measured
+209 -> 165 ms/epoch end-to-end (1.27x). Whole-model im2col loses
+(conv2's patches are an 800-wide materialization, exp_im2col.py);
+the win is im2col for conv1 ONLY, and only its fwd + dw (its dx
+would cost a scatter-add the first layer never needs).
 
 All operands ride the fori_loop carry (nothing closed over): big
 closed-over arrays inflate the serialized HLO the axon tunnel ships
@@ -162,29 +172,38 @@ def main() -> None:
         return vjp(cot)[0] + x, w
 
     def g_conv_w(c):
-        x, w = c
+        # cotangent rides the CARRY (precomputed once outside): a
+        # `cot = conv(x, w)` inside the body would add a full forward
+        # to every "wgrad" number. The vjp's own primal is DCE'd (its
+        # output is unused and conv wgrad needs no output residual).
+        x, w, cot = c
         _, vjp = jax.vjp(lambda ww: conv(x, ww), w)
-        cot = conv(x, w)
-        return x, vjp(cot)[0] + w
+        dw = vjp(cot)[0]
+        return x, dw + w, cot + jnp.broadcast_to(
+            dw.sum((1, 2, 3))[:, None, None, None, :], cot.shape)
 
     probe("conv1 dgrad grouped", g_conv_x, (x1, w1))
-    probe("conv1 wgrad grouped", g_conv_w, (x1, w1))
+    probe("conv1 wgrad grouped", g_conv_w,
+          (x1, w1, jax.jit(conv)(x1, w1)))
     probe("conv2 dgrad grouped", g_conv_x, (x2, w2))
-    probe("conv2 wgrad grouped", g_conv_w, (x2, w2))
+    probe("conv2 wgrad grouped", g_conv_w,
+          (x2, w2, jax.jit(conv)(x2, w2)))
 
     def g_conv1_im2col(c):
-        """combined dx+dw through the im2col formulation"""
-        x, w = c
+        """dx+dw through the im2col formulation, cotangent carried"""
+        x, w, cot = c
 
         def f(xx, ww):
             p = patches(xx)
             return jnp.einsum("nbhwk,nkc->nbhwc", p, ww.reshape(n, 25, 32))
 
-        out, vjp = jax.vjp(f, x, w)
-        dx, dw = vjp(out)
-        return dx + x, dw + w
+        _, vjp = jax.vjp(f, x, w)
+        dx, dw = vjp(cot)
+        return dx + x, dw + w, cot + jnp.broadcast_to(
+            dw.sum((1, 2, 3))[:, None, None, None, :], cot.shape)
 
-    probe("conv1 im2col dx+dw", g_conv1_im2col, (x1, w1))
+    probe("conv1 im2col dx+dw", g_conv1_im2col,
+          (x1, w1, jax.jit(conv)(x1, w1)))
 
 
 if __name__ == "__main__":

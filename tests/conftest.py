@@ -9,6 +9,7 @@ os.environ mutation in conftest (pytest imports conftest first).
 """
 
 import os
+import pathlib
 
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
@@ -16,11 +17,42 @@ if "xla_force_host_platform_device_count" not in _flags:
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+# Persistent XLA compile cache: the suite is compile-dominated (the
+# vmapped round programs recompile identically every run), so warm
+# runs skip most of the wall-clock. Separate dir from the TPU bench
+# cache (.jax_cache) to keep either side prunable on its own.
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR",
+    str(pathlib.Path(__file__).resolve().parent.parent / ".jax_cache_cpu"),
+)
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slowtier: minutes-long redundancy-coverage tests, skipped "
+        "unless P2PFL_SLOW_TESTS=1 (their mechanisms have faster "
+        "in-suite guards; see each test's docstring)",
+    )
+
+
+def pytest_collection_modifyitems(config, items):
+    if os.environ.get("P2PFL_SLOW_TESTS"):
+        return
+    skip = pytest.mark.skip(
+        reason="slow tier — set P2PFL_SLOW_TESTS=1 to run"
+    )
+    for item in items:
+        if "slowtier" in item.keywords:
+            item.add_marker(skip)
 
 
 @pytest.fixture(scope="session")

@@ -109,14 +109,32 @@ class TestAggregationSession:
         np.testing.assert_allclose(s.result[0]["w"], 7.0)
 
 
+_SHARED_TRAINER = None
+
+
+def _shared_trainer():
+    """One compiled mnist-mlp trainer for EVERY socket-federation test
+    in this module (and test_netem/test_tls, which reuse
+    _run_federation): without it each test compiles n_nodes identical
+    XLA programs — tens of wasted suite seconds per test."""
+    global _SHARED_TRAINER
+    if _SHARED_TRAINER is None:
+        from p2pfl_tpu.learning.learner import SharedTrainer
+
+        _SHARED_TRAINER = SharedTrainer(get_model("mnist-mlp"),
+                                        learning_rate=0.05, batch_size=32)
+    return _SHARED_TRAINER
+
+
 def _make_learners(n, samples=150):
     fed = FederatedDataset.make(
         DataConfig(dataset="mnist", samples_per_node=samples), n
     )
     learners = []
     for i in range(n):
-        ln = JaxLearner(model=get_model("mnist-mlp"), data=fed.nodes[i],
-                        learning_rate=0.05, seed=0)
+        ln = JaxLearner(model=None, data=fed.nodes[i],
+                        learning_rate=0.05, seed=0,
+                        trainer=_shared_trainer())
         learners.append(ln)
     return fed, learners
 

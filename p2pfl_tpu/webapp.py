@@ -1062,8 +1062,10 @@ def main(argv: list[str] | None = None) -> int:
             import getpass
 
             password = getpass.getpass(f"password for {args.add_user}: ")
-        UserStore(args.users).add(args.add_user, password, args.role)
-        print(f"user {args.add_user!r} ({args.role}) saved to {args.users}")
+        store = UserStore(args.users)
+        store.add(args.add_user, password, args.role)
+        effective = store.list().get(args.add_user)
+        print(f"user {args.add_user!r} ({effective}) saved to {args.users}")
         return 0
 
     token = None if args.read_only else (args.token or secrets.token_urlsafe(24))

@@ -58,10 +58,10 @@ def main() -> int:
     xt = jax.random.normal(kt, (512, 32, 32, 3), jnp.float32)
     yt = jax.random.randint(ky, (512,), 0, 10)
 
-    def per_node(p, o):
+    def per_node(p, o, xb, yb):
         def loss(pp):
             return optax.softmax_cross_entropy_with_integer_labels(
-                model.apply(pp, x[0]), y[0]).mean()
+                model.apply(pp, xb), yb).mean()
         l, g = jax.value_and_grad(loss)(p)
         up, o2 = tx.update(g, o, p)
         return optax.apply_updates(p, up), o2, l
@@ -74,7 +74,7 @@ def main() -> int:
     def trajectory(params, opt, length):
         def body(r, carry):
             params, opt, accs = carry
-            params, opt, _ = jax.vmap(per_node)(params, opt)
+            params, opt, _ = jax.vmap(per_node)(params, opt, x, y)
             if args.eval:
                 accs = accs.at[r].set(jnp.mean(jax.vmap(eval_node)(params)))
             return params, opt, accs

@@ -45,7 +45,7 @@ def pytest_configure(config):
 
 
 def pytest_collection_modifyitems(config, items):
-    if os.environ.get("P2PFL_SLOW_TESTS"):
+    if os.environ.get("P2PFL_SLOW_TESTS", "0") not in ("", "0"):
         return
     skip = pytest.mark.skip(
         reason="slow tier — set P2PFL_SLOW_TESTS=1 to run"

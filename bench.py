@@ -725,6 +725,14 @@ def _phase_vit32() -> None:
     _part(_vit32(timeout_s=deadline))
 
 
+def _phase_selftest() -> None:
+    """Test hook (tests/test_bench_orchestration.py): emit one part,
+    then crash — exercises the parent's guarantee that parts from a
+    failing child are kept, without touching any accelerator."""
+    _part({"selftest_key": 41})
+    raise RuntimeError("selftest crash after part")
+
+
 def _stream_child(fn_name: str, deadline: float, on_part) -> str | None:
     """Parent-side: run ``bench.<fn_name>()`` in a subprocess, calling
     ``on_part(dict)`` for each streamed part the moment it arrives.

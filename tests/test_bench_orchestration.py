@@ -1,0 +1,50 @@
+"""bench.py orchestration contract (round 4): the driver parses the
+LAST stdout line, so under ANY budget the bench must end with one
+parseable JSON object carrying the required keys — round 3 lost every
+number to a timeout precisely because this wasn't guaranteed."""
+
+import json
+import os
+import pathlib
+import subprocess
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def test_zero_budget_still_emits_parseable_json():
+    env = dict(os.environ, P2PFL_BENCH_BUDGET_S="0")
+    res = subprocess.run(
+        [sys.executable, str(REPO / "bench.py")],
+        capture_output=True, text=True, timeout=120, env=env, cwd=REPO,
+    )
+    assert res.returncode == 0, res.stderr[-500:]
+    last = res.stdout.strip().splitlines()[-1]
+    out = json.loads(last)
+    # driver contract keys
+    for key in ("metric", "value", "unit", "vs_baseline"):
+        assert key in out, key
+    assert out["metric"] == "femnist_cnn_64node_ring_round_wall_clock"
+    assert out["unit"] == "s/round"
+    # with zero budget (t_end == t_start, remaining negative
+    # everywhere), every phase is explicitly accounted as skipped
+    assert set(out["skipped_phases"]) == {
+        "headline", "cifar16", "cpu8", "socket24", "vit32"
+    }
+
+
+def test_stream_child_keeps_parts_from_failing_child():
+    """A phase child that emits a part and THEN dies must still
+    deliver the part (the monotone-artifact guarantee round 3's
+    timeout loss motivated)."""
+    import time as _time
+
+    sys.path.insert(0, str(REPO))
+    import bench
+
+    parts = []
+    err = bench._stream_child("_phase_selftest",
+                              deadline=_time.monotonic() + 60,
+                              on_part=parts.append)
+    assert parts == [{"selftest_key": 41}]
+    assert err is not None and "rc=" in err

@@ -331,10 +331,12 @@ def _accuracy_run(run, target: float = 0.80, max_rounds: int = 30,
     instead of one fori_loop program. Round-3 history: the fused
     composition of the ViT round (Pallas flash + remat + nn.scan) AND
     its eval intermittently faulted the TPU worker. Round-4 status:
-    with ``shared_aggregate`` (cuts the transient aggregate memory)
-    and the lane-replicated flash stats layout, the SAME config runs
-    fused 3x stable (scripts/repro_fused_fault.py; docs/perf.md), so
-    fused is the default again and unfused is the fallback."""
+    the fault is probabilistic (~1 in 6 full executions), not
+    structural — the identical fused program ran clean five times
+    (scripts/repro_fused_fault.py; docs/perf.md §5) — so fused is the
+    default, unfused the in-process fallback, and the flash phase's
+    child isolation + progressive emission absorb a recurrence."""
+    import jax
     import jax.numpy as jnp
     import numpy as np
 
@@ -362,6 +364,11 @@ def _accuracy_run(run, target: float = 0.80, max_rounds: int = 30,
     seconds = None
     if r80 is not None and measure_seconds:
         fed1 = run["reset"](1)
+        # the fresh federation state must be ON DEVICE before the
+        # clock starts — otherwise its (multi-GB) transfer lands
+        # nondeterministically inside the timed window (observed:
+        # 2.1 vs 4.8 s for the same 8-round re-run)
+        jax.block_until_ready(fed1)
         t0 = time.monotonic()
         _, accs2 = traj(fed1, r80)
         float(jnp.sum(accs2))

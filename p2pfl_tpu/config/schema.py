@@ -40,6 +40,12 @@ class DataConfig:
     # federation you asked for. Ignored when real data exists.
     synthetic_train: int | None = None
     synthetic_test: int | None = None
+    # surrogate difficulty (datasets/sources.py): "hard" (default —
+    # writer styles + held-out-writer test + class skew + label noise,
+    # calibrated to plateau ~0.85-0.92) or "easy" (rounds 1-4 profile,
+    # saturates ~0.99; kept for metric continuity). Ignored when real
+    # data exists.
+    surrogate_profile: str = "hard"
 
 
 @dataclasses.dataclass

@@ -88,10 +88,13 @@ class FederatedDataset:
                 if config.synthetic_train else None
             )
             splits = get_dataset(config.dataset, seed=config.seed,
-                                 synthetic_sizes=sizes)
+                                 synthetic_sizes=sizes,
+                                 profile=getattr(config, "surrogate_profile",
+                                                 "hard"))
         parts = partition_indices(
             splits.y_train, n_nodes, scheme=config.partition,
             seed=config.seed, alpha=config.dirichlet_alpha,
+            groups=splits.writer_train,
         )
         nodes = []
         for node_i, idx in enumerate(parts):

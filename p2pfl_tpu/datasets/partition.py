@@ -60,9 +60,27 @@ def dirichlet_partition(
     )
 
 
+def writer_partition(groups: np.ndarray, n_nodes: int,
+                     seed: int = 0) -> list[np.ndarray]:
+    """LEAF-style natural non-IID: whole writers (source groups) are
+    assigned to nodes, so every node inherits its writers' class skew
+    and style — the reference's FEMNIST is partitioned exactly this
+    way (femnist.py: one LEAF writer bundle per participant)."""
+    rng = np.random.default_rng(seed)
+    writers = rng.permutation(np.unique(groups))
+    if len(writers) < n_nodes:
+        raise ValueError(
+            f"writer partition needs >= 1 writer per node: "
+            f"{len(writers)} writers < {n_nodes} nodes"
+        )
+    assignment = {w: i % n_nodes for i, w in enumerate(writers)}
+    node_of = np.vectorize(assignment.get, otypes=[np.int64])(groups)
+    return [np.flatnonzero(node_of == i) for i in range(n_nodes)]
+
+
 def partition_indices(
     labels: np.ndarray, n_nodes: int, scheme: str = "iid", seed: int = 0,
-    alpha: float = 0.5,
+    alpha: float = 0.5, groups: np.ndarray | None = None,
 ) -> list[np.ndarray]:
     """Factory by scheme name (DataConfig.partition)."""
     if scheme == "iid":
@@ -71,4 +89,11 @@ def partition_indices(
         return sorted_partition(labels, n_nodes, seed)
     if scheme == "dirichlet":
         return dirichlet_partition(labels, n_nodes, alpha=alpha, seed=seed)
+    if scheme == "writer":
+        if groups is None:
+            raise ValueError(
+                "partition='writer' needs per-sample writer ids "
+                "(dataset provides none)"
+            )
+        return writer_partition(groups, n_nodes, seed)
     raise ValueError(f"unknown partition scheme {scheme!r}")

@@ -38,16 +38,12 @@ def save_checkpoint(directory: str | pathlib.Path, fed: FederatedState) -> pathl
     directory = pathlib.Path(directory)
     multi = jax.process_count() > 1
     if multi:
-        from jax.experimental import multihost_utils
+        # fetch_global also covers processes that own no device of the
+        # federation submesh (replicated leaves have no local shard
+        # there — the 4-process/6-node test shape)
+        from p2pfl_tpu.parallel.mesh import fetch_global
 
-        def to_host(x):
-            if getattr(x, "is_fully_addressable", True):
-                return np.asarray(x)
-            return np.asarray(
-                multihost_utils.process_allgather(x, tiled=True)
-            )
-
-        host = jax.tree.map(to_host, fed)
+        host = jax.tree.map(fetch_global, fed)
     else:
         host = jax.tree.map(np.asarray, fed)
     path = checkpoint_path(directory, int(host.round))

@@ -167,6 +167,10 @@ class Scenario(Observable):
                 self.fns, aggregator=self.aggregator,
                 epochs=config.training.epochs_per_round,
                 shared_aggregate=shared,
+                # DFL plans always adopt their own row (make_round_plan)
+                # -> the agg[adopt] whole-stack gather pass is elided;
+                # CFL/SDFL adopt the leader's row and keep it
+                identity_adopt=config.federation == "DFL",
             )
         self._round_fn = tr.compile_round(round_fn)
         self._eval_fn = tr.compile_eval(build_eval_fn(self.fns))
@@ -181,22 +185,21 @@ class Scenario(Observable):
         )
         # resumed runs continue the FL-aware global-step x-axis
         self.global_step = (
-            int(np.asarray(self.fed.round)) * self._steps_per_round
+            int(self._node_host(self.fed.round)) * self._steps_per_round
         )
         self._plan_cache: dict[tuple, tuple] = {}
 
     # ------------------------------------------------------------------
     def _node_host(self, x) -> np.ndarray:
-        """Node-sharded device array -> full host copy. On a multi-host
-        mesh the per-node leaves are only partially addressable here,
-        so they come back via an allgather; single-process is a plain
+        """Device array (node-sharded or replicated) -> full host copy
+        on every process. Multi-host fetches route through
+        ``mesh.fetch_global`` — which also serves processes owning no
+        device of the federation submesh; single-process is a plain
         transfer."""
         if jax.process_count() > 1:
-            from jax.experimental import multihost_utils
+            from p2pfl_tpu.parallel.mesh import fetch_global
 
-            return np.asarray(
-                multihost_utils.process_allgather(x, tiled=True)
-            )
+            return fetch_global(x)
         return np.asarray(x)
 
     def _choose_sparse(self) -> bool:
@@ -247,7 +250,7 @@ class Scenario(Observable):
         # rotation (advancing self._rng through the same draw sequence)
         # as the uninterrupted run, so eviction timing, the leader, and
         # every subsequent mix weight match exactly
-        start_round = int(np.asarray(self.fed.round))
+        start_round = int(self._node_host(self.fed.round))
         for r in range(start_round):
             alive = self._advance_membership(r)
             self._rotate_leader(alive, replay=True)
@@ -393,7 +396,7 @@ class Scenario(Observable):
         rounds_to_target = None
         ev = None
         ev_round = -1  # round the last evaluation reflects
-        start_round = int(np.asarray(self.fed.round))
+        start_round = int(self._node_host(self.fed.round))
         # profile ONE steady-state round (the second of the run when
         # there is one — the first carries compile time); SURVEY §5.1's
         # jax.profiler hook. try/finally: an exception mid-profiled-

@@ -34,6 +34,18 @@ from p2pfl_tpu.p2p.node import P2PNode
 from p2pfl_tpu.topology.topology import generate_topology
 
 
+def _declares_full_mesh(cfg) -> bool:
+    """True when the launcher can PROMISE every pair of nodes a healthy
+    direct link: fully-connected topology with no link shaping at all.
+    Any shaping (loss, delay, jitter, or a rate cap that can convoy
+    beats behind multi-MB PARAMS frames) disqualifies — relay damping
+    must not remove the repair path on links the shaper degrades."""
+    net = cfg.network
+    return cfg.topology == "fully" and not (
+        net.loss_pct or net.delay_ms or net.jitter_ms or net.rate_mbps
+    )
+
+
 def _free_ports(n: int) -> list[int]:
     socks, ports = [], []
     for _ in range(n):
@@ -90,6 +102,7 @@ async def _run_node(cfg: ScenarioConfig, idx: int, ports: list[int],
         seed=cfg.seed,
         tls=tls,
         netem=cfg.network,
+        full_mesh=_declares_full_mesh(cfg),
     )
     await node.start()
     topo = generate_topology(cfg.topology, n, **cfg.topology_kwargs)
@@ -201,6 +214,7 @@ async def _simulate(cfg: ScenarioConfig, timeout: float = 600) -> dict:
             federation=cfg.federation,
             seed=cfg.seed,
             netem=cfg.network,
+            full_mesh=_declares_full_mesh(cfg),
         )
         for i in range(n)
     ]

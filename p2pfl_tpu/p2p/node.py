@@ -40,6 +40,7 @@ from p2pfl_tpu.core.serialize import decode_parameters, encode_parameters
 from p2pfl_tpu.federation.membership import Membership
 from p2pfl_tpu.p2p.protocol import (
     GOSSIPED,
+    PERIODIC_FLOODS,
     DedupRing,
     Message,
     MsgType,
@@ -92,6 +93,7 @@ class P2PNode:
         seed: int = 0,
         tls=None,
         netem=None,
+        full_mesh: bool = False,
     ):
         from p2pfl_tpu.p2p.session import AggregationSession
 
@@ -110,6 +112,16 @@ class P2PNode:
             else self.protocol.gossip_period_s
         )
         self.federation = federation
+        # Declared-full-mesh relay suppression (set by the launcher for
+        # topology="fully" ONLY): when every pair of nodes holds a
+        # direct link by construction, the origin's broadcast already
+        # reached everyone and epidemic re-relay multiplies control
+        # traffic by the fanout for zero reach (measured ~1.2M frames
+        # over 3 rounds at 24 nodes, exp_socket_profile.py). This must
+        # be DECLARED, not inferred from len(peers) == n-1: in a line
+        # 0-1-2 the middle node has n-1 peers while the ends cannot
+        # reach each other except through its relay.
+        self.full_mesh = full_mesh
         # mutual TLS (p2pfl_tpu.p2p.tls.TLSCredentials) — replaces the
         # reference's RSA/AES-ECB handshake (encrypter.py:48-193).
         # With TLS on, every self-originated message is origin-signed
@@ -375,10 +387,28 @@ class P2PNode:
             if self.dedup.seen(msg.msg_id):
                 return  # already processed — at-most-once
             if not self._verify_origin(msg):
-                return  # forged: not processed, not forwarded, NOT seen
+                return  # forged: not processed, not forwarded, NOT SEEN
             self.dedup.check_and_add(msg.msg_id)
-            await self._forward(msg, exclude=peer.idx,
-                                limit=self.protocol.gossip_fanout)
+            # Relay damping on DECLARED full meshes (see __init__),
+            # PERIODIC flood types only: the origin's direct broadcast
+            # already reached everyone, so relays are pure redundancy —
+            # but a DEAD A-B link with both ends otherwise fully
+            # connected is invisible to the relaying third party C
+            # (C still has n-1 peers), and C's relay is the only path
+            # keeping A/B from falsely evicting each other. So periodic
+            # floods relay at 10% instead of 0%: ~90% of the measured
+            # relay traffic gone, while a beat still crosses a broken
+            # link within a few periods (well inside node_timeout_s).
+            # One-shot floods (STOP, votes, leadership) always relay.
+            # The peer-count guard restores full relaying whenever this
+            # node's own links are down.
+            damped = (self.full_mesh
+                      and msg.type in PERIODIC_FLOODS
+                      and len(self.peers) >= self.n_nodes - 1
+                      and self._rng.random() >= 0.1)
+            if not damped:
+                await self._forward(msg, exclude=peer.idx,
+                                    limit=self.protocol.gossip_fanout)
         elif msg.type is MsgType.PARAMS and not self._verify_origin(msg):
             return
         t = msg.type
@@ -518,6 +548,7 @@ class P2PNode:
         if self._signer is not None and not msg.sig:
             msg.sig = self._signer.sign(msg.signing_bytes())
             msg.cert = self._signer.cert_pem
+            msg._wire = None  # signature changes the frame memo
         return msg
 
     def _verify_origin(self, msg: Message) -> bool:

@@ -71,6 +71,22 @@ GOSSIPED = frozenset(
     }
 )
 
+#: flooded types that are re-sent PERIODICALLY (heartbeats + the role
+#: refresh riding every 2nd beat): a lost copy is replaced by the next
+#: beat, so on a launcher-declared full mesh their epidemic re-relay
+#: can be suppressed (node.py _dispatch) — the origin's direct
+#: broadcast already reached every node. Everything else — including
+#: per-ROUND progress frames (MODELS_READY/AGGREGATED/INITIALIZED),
+#: which are one-shot within their round, not periodic — is ALWAYS
+#: relayed: delivery must survive a single broken link that the
+#: relaying node cannot observe locally.
+PERIODIC_FLOODS = frozenset(
+    {
+        MsgType.BEAT,
+        MsgType.ROLE,
+    }
+)
+
 
 @dataclasses.dataclass
 class Message:
@@ -88,6 +104,13 @@ class Message:
     # ORIGIN, not the relaying connection (see p2p.tls).
     sig: bytes = b""
     cert: bytes = b""
+    # framed-bytes memo: a broadcast/relay writes the SAME message to
+    # up to n-1 peers, and per-peer re-encoding was ~10% of the socket
+    # federation's CPU (scripts/exp_socket_profile.py). Set on first
+    # encode; _sign() (the only post-construction mutation on the send
+    # path) invalidates it.
+    _wire: bytes | None = dataclasses.field(
+        default=None, repr=False, compare=False)
 
     def __post_init__(self):
         if not self.msg_id and self.type in GOSSIPED:
@@ -112,6 +135,8 @@ class Message:
         )
 
     def encode(self) -> bytes:
+        if self._wire is not None:
+            return self._wire
         frame = msgpack.packb(
             {
                 "t": self.type.value,
@@ -126,7 +151,8 @@ class Message:
         )
         if len(frame) > MAX_FRAME:
             raise ValueError(f"frame too large: {len(frame)} bytes")
-        return _LEN.pack(len(frame)) + frame
+        self._wire = _LEN.pack(len(frame)) + frame
+        return self._wire
 
     @staticmethod
     def decode(frame: bytes) -> "Message":

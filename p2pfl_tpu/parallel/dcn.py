@@ -62,12 +62,20 @@ def initialize(coordinator_address: str, num_processes: int,
 
 def make_global(x, sharding):
     """Materialize a host array as a global sharded array: each process
-    fills only the shards it owns (the DCN-safe device_put)."""
+    fills only the shards it owns (the DCN-safe device_put).
+
+    ``dtype`` is passed explicitly: a process whose devices all fall
+    OUTSIDE the federation mesh (e.g. 6 nodes on 4 hosts x 2 devices —
+    the divisor rule uses 6 of 8 devices, host 3 owns none) fills no
+    shards, and make_array_from_callback cannot infer the dtype from
+    an empty shard list."""
     import jax
     import numpy as np
 
     x = np.asarray(x)
-    return jax.make_array_from_callback(x.shape, sharding, lambda idx: x[idx])
+    return jax.make_array_from_callback(
+        x.shape, sharding, lambda idx: x[idx], dtype=x.dtype
+    )
 
 
 def run_federation(rounds: int = 1, dataset: str = "mnist",

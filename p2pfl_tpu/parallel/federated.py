@@ -163,6 +163,7 @@ def build_round_fn(
     epochs: int = 1,
     exchange_dtype: Any | None = None,
     shared_aggregate: bool = False,
+    identity_adopt: bool = False,
 ) -> Callable:
     """Build the jittable ``round_fn(fed, x, y, mask, n_samples, plan
     arrays) -> (fed, metrics)``.
@@ -192,6 +193,14 @@ def build_round_fn(
     nodes) that redundancy is the difference between fitting and
     faulting. Semantically identical where the contract holds; rows
     with no incoming weight still keep their own params.
+
+    ``identity_adopt=True`` is the caller's PROMISE that every plan fed
+    to this round fn has ``adopt == arange(n)`` (always true for DFL,
+    make_round_plan): the ``agg[adopt]`` gather is a full extra
+    read+write pass over the model stack that XLA cannot elide for a
+    runtime index array, so the promise buys one whole-stack memory
+    pass per round (~4 ms at the 64-node north star). CFL/SDFL route
+    through a leader and must keep the default.
     """
     aggregator = aggregator or FedAvg()
     fedavg_fast = type(aggregator) is FedAvg
@@ -259,11 +268,14 @@ def build_round_fn(
         # nodes with an all-zero row (nothing arrived before "timeout",
         # aggregator.py:53-76) keep their own params
         got_any = jnp.sum(w, axis=1) > 0
-        if not (shared_aggregate and not fedavg_fast):
+        if identity_adopt:
+            pass  # adopt == arange(n) by caller contract: gather elided
+        elif not (shared_aggregate and not fedavg_fast):
             # shared aggregates are already identical across rows, so
             # the adopt gather would only copy
             agg = jax.tree.map(lambda a: a[adopt], agg)
-        keep = jnp.logical_and(alive, got_any[adopt])
+        keep = jnp.logical_and(
+            alive, got_any if identity_adopt else got_any[adopt])
         params = _tree_sel(keep, agg, states.params)
 
         fed = FederatedState(

@@ -46,3 +46,38 @@ def shard_stacked(tree, mesh: Mesh):
     mesh. Requires the node count to divide evenly over devices."""
     sh = stacked_sharding(mesh)
     return jax.tree.map(lambda x: jax.device_put(x, sh), tree)
+
+
+def fetch_global(x) -> np.ndarray:
+    """Device array -> full host copy, valid on EVERY process of a
+    multi-process job — including processes that own no device of the
+    array's (sub)mesh (e.g. 6 federated nodes over 4 hosts x 2 devices:
+    the divisor rule meshes 6 of 8 devices and host 3 holds nothing).
+
+    ``process_allgather`` alone cannot serve a meshless process: its
+    gather runs (and leaves its output) on the ARRAY's mesh, so a
+    process outside that mesh can neither read a replicated shard nor
+    fetch the gathered result. When the array's devices are a strict
+    subset of the global devices, shard-owning processes resolve the
+    full value locally (shard read for replicated, allgather for
+    sharded) and ``broadcast_one_to_all`` — a true global collective —
+    ships process 0's copy everywhere (process 0 owns mesh device 0 by
+    construction, so it always has the value).
+    """
+    if getattr(x, "is_fully_addressable", True):
+        return np.asarray(x)
+    from jax.experimental import multihost_utils
+
+    submesh = len(x.sharding.device_set) < len(jax.devices())
+    if not submesh:
+        return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+    if x.addressable_shards:
+        if x.sharding.is_fully_replicated:
+            local = np.asarray(x.addressable_shards[0].data)
+        else:
+            local = np.asarray(
+                multihost_utils.process_allgather(x, tiled=True)
+            )
+    else:
+        local = np.zeros(x.shape, x.dtype)  # ignored: not the source
+    return np.asarray(multihost_utils.broadcast_one_to_all(local))

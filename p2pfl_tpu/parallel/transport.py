@@ -147,8 +147,11 @@ class MeshTransport:
             import numpy as np
 
             arr = np.asarray(x)
+            # explicit dtype: a process whose devices all fall outside
+            # the federation mesh fills no shards, and the dtype can't
+            # be inferred from an empty shard list (dcn.make_global)
             return jax.make_array_from_callback(
-                arr.shape, sharding, lambda idx: arr[idx]
+                arr.shape, sharding, lambda idx: arr[idx], dtype=arr.dtype
             )
         return jax.device_put(jnp.asarray(x), sharding)
 

@@ -47,17 +47,20 @@ class UserStore:
         degradation, not a failure)."""
         self.path.parent.mkdir(parents=True, exist_ok=True)
         lock_path = self.path.with_suffix(".lock")
+        # only the import is guarded: a try around the yield would
+        # swallow an ImportError raised inside the locked BODY and
+        # yield a second time ("generator didn't stop after throw")
         try:
             import fcntl
-
-            with open(lock_path, "w") as lk:
-                fcntl.flock(lk, fcntl.LOCK_EX)
-                try:
-                    yield
-                finally:
-                    fcntl.flock(lk, fcntl.LOCK_UN)
         except ImportError:
             yield
+            return
+        with open(lock_path, "w") as lk:
+            fcntl.flock(lk, fcntl.LOCK_EX)
+            try:
+                yield
+            finally:
+                fcntl.flock(lk, fcntl.LOCK_UN)
 
     def _load(self) -> dict:
         if not self.path.is_file():

@@ -41,6 +41,14 @@ Session surface (enabled by ``--users users.json``):
 - ``POST /logout``    — drops the session
 - ``GET /admin/users``, ``POST /api/users/add|remove`` — admin-role
   user CRUD (the reference's user administration, app.py:222-254)
+- with a user store configured, the READ surface (index, charts,
+  metrics, log tails, downloads) also requires a session or the
+  bearer token — matching the reference's login-gated views; without
+  one, reads stay open (token-only automation servers)
+- cookie-authenticated state-changing POSTs carry a per-session CSRF
+  token (hidden form field / ``csrf`` JSON key) on top of
+  ``SameSite=Strict``; bearer-token calls are exempt (no cookie to
+  ride)
 
 Charts: ``/charts/<name>`` renders per-node scalar curves (loss,
 accuracy, ...) from ``metrics.jsonl`` as inline SVG — the role of the
@@ -61,6 +69,7 @@ Run: ``python -m p2pfl_tpu.webapp <log_root> [--port 8666] [--token T]``
 from __future__ import annotations
 
 import argparse
+import hashlib
 import html
 import json
 import math
@@ -503,6 +512,44 @@ class DashboardHandler(BaseHTTPRequestHandler):
         s = self._session()
         return s is not None and s.get("role") == "admin"
 
+    def _read_ok(self) -> bool:
+        """Read routes: open when no user store is configured (token-
+        only servers match rounds 1-3 behavior), but once ``--users``
+        exists the whole read surface (charts, log tails, metrics,
+        downloads) requires a session or the bearer token — the
+        reference gates ALL views behind login (app.py:195-254), and
+        metrics/logs must not be more exposed here than there."""
+        return (self.users is None or self._session() is not None
+                or self._token_ok())
+
+    @staticmethod
+    def _derive_csrf(session_token: str) -> str:
+        """Per-session CSRF token, derived (not stored): a hidden form
+        field the attacker's cross-site form cannot know. SameSite is
+        the first line; this covers older/non-conforming clients."""
+        return hashlib.sha256(b"csrf:" + session_token.encode()).hexdigest()[:32]
+
+    def _csrf_field(self) -> str:
+        """Hidden input for cookie-authenticated HTML forms."""
+        tok = self._session_token()
+        if self.sessions.get(tok) is None:
+            return ""
+        return (f"<input type='hidden' name='csrf' "
+                f"value='{self._derive_csrf(tok)}'>")
+
+    def _csrf_ok(self, body: bytes, form: dict | None) -> bool:
+        """State-changing POSTs authorized by a session COOKIE must
+        carry the session's CSRF token; bearer-token callers are not
+        cookie-authenticated, so no cross-site form can ride them."""
+        if self._token_ok(form):
+            return True
+        tok = self._session_token()
+        if self.sessions.get(tok) is None:
+            return False  # unauthenticated — the auth check 401s first
+        supplied = self._field(body, form, "csrf")
+        return bool(supplied) and secrets.compare_digest(
+            supplied, self._derive_csrf(tok))
+
     def do_POST(self) -> None:  # noqa: N802 (http.server API)
         parts = [unquote(p) for p in self.path.split("?")[0].split("/") if p]
         try:
@@ -525,11 +572,15 @@ class DashboardHandler(BaseHTTPRequestHandler):
             if len(parts) == 3 and parts[:2] == ["api", "users"]:
                 if not self._admin_ok(form):
                     return self._json_code({"error": "admin required"}, 401)
+                if not self._csrf_ok(body, form):
+                    return self._json_code({"error": "bad csrf token"}, 403)
                 return self._users_crud(parts[2], body, form)
             if not self._authorized(form):
                 return self._json_code(
                     {"error": "missing or bad auth token"}, 401
                 )
+            if not self._csrf_ok(body, form):
+                return self._json_code({"error": "bad csrf token"}, 403)
             if parts == ["api", "scenario", "run"] or parts == [
                 "scenario", "deployment", "run"
             ]:
@@ -570,7 +621,13 @@ class DashboardHandler(BaseHTTPRequestHandler):
         )
 
         if form is None:
-            return ScenarioConfig.from_dict(json.loads(body.decode()))
+            d = json.loads(body.decode())
+            # auth fields ride the same JSON body for cookie-session
+            # clients; they are not scenario knobs
+            if isinstance(d, dict):
+                d.pop("csrf", None)
+                d.pop("token", None)
+            return ScenarioConfig.from_dict(d)
 
         def one(key, default=None):
             vals = form.get(key)
@@ -642,7 +699,10 @@ class DashboardHandler(BaseHTTPRequestHandler):
             vals = form.get(key)
             return vals[0] if vals else ""
         try:
-            val = json.loads(body.decode() or "{}").get(key, "")
+            obj = json.loads(body.decode() or "{}")
+            if not isinstance(obj, dict):  # JSON array/scalar body
+                return ""
+            val = obj.get(key, "")
             return val if isinstance(val, str) else ""
         except ValueError:
             return ""
@@ -744,11 +804,12 @@ class DashboardHandler(BaseHTTPRequestHandler):
                       "</a></p>"),
                 code=401,
             )
+        csrf = self._csrf_field()
         rows = "".join(
             f"<tr><td>{html.escape(u)}</td><td>{html.escape(r)}</td>"
             f"<td><form method='post' action='/api/users/remove' "
             f"style='margin:0'><input type='hidden' name='user' "
-            f"value='{html.escape(u, quote=True)}'>"
+            f"value='{html.escape(u, quote=True)}'>{csrf}"
             f"<button>remove</button></form></td></tr>"
             for u, r in self.users.list().items()
         )
@@ -761,7 +822,7 @@ class DashboardHandler(BaseHTTPRequestHandler):
             "</label> <label>role <select name='role'>"
             "<option value=''>(keep existing / user)</option>"
             "<option>user</option><option>admin</option></select></label> "
-            "<button>save</button></form>"
+            f"{csrf}<button>save</button></form>"
         )
         self._send(_page("user administration", body))
 
@@ -786,10 +847,17 @@ class DashboardHandler(BaseHTTPRequestHandler):
         self._send(_page(f"charts — {html.escape(name)}", body, refresh=10))
 
     def _route(self, parts: list[str]) -> None:
-        if not parts:
-            return self._index()
         if parts == ["login"]:
             return self._login_page()
+        if not self._read_ok():
+            if parts and parts[0] == "api":
+                return self._json_code({"error": "login required"}, 401)
+            self.send_response(303)
+            self.send_header("Location", "/login")
+            self.end_headers()
+            return
+        if not parts:
+            return self._index()
         if parts == ["admin", "users"]:
             return self._admin_users_page()
         if len(parts) == 2 and parts[0] == "charts":
@@ -887,7 +955,7 @@ class DashboardHandler(BaseHTTPRequestHandler):
             "<label>samples/node <input name='samples_per_node' value='256' "
             "size='6'></label>"
             "</p><p><label>auth token <input name='token' type='password'>"
-            "</label> <button>deploy</button></p></form>"
+            f"</label> {self._csrf_field()}<button>deploy</button></p></form>"
         )
         self._send(_page("scenario designer", body))
 

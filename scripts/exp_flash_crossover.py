@@ -1,0 +1,100 @@
+"""Flash-vs-XLA attention crossover on the bench chip (VERDICT r4 #2a).
+
+The flash kernel's claimed win is long sequences; the only recorded
+measurement (vit32 at 65 tokens) is a 1.8x LOSS. This measures both
+paths' fwd+bwd step at seq 128..4096 on real hardware so the kernel's
+existence (and its default-off gating) is justified by data.
+
+Per point: a training-shaped program — attention + a scalar loss,
+grad w.r.t. q/k/v — scan-slope timed (the exp_op_breakdown harness).
+
+Usage: python scripts/exp_flash_crossover.py [--seqs 128,256,...]
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def slope(body, carry0, k1=2, k2=6, reps=3):
+    def run(k):
+        @jax.jit
+        def prog(c):
+            return jax.lax.fori_loop(0, k, lambda i, c: body(c), c)
+
+        def sync(out):
+            leaf = jax.tree.leaves(out)[0]
+            return float(jnp.sum(leaf.astype(jnp.float32)))
+
+        sync(prog(carry0))
+        times = []
+        for _ in range(reps):
+            t0 = time.monotonic()
+            out = prog(carry0)
+            sync(out)
+            times.append(time.monotonic() - t0)
+        return float(np.median(times))
+
+    t1, t2 = run(k1), run(k2)
+    return (t2 - t1) / (k2 - k1) * 1000
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seqs", default="128,256,512,1024,2048,4096")
+    ap.add_argument("--heads", type=int, default=4)
+    ap.add_argument("--dim", type=int, default=64)
+    ap.add_argument("--tokens", type=int, default=16384,
+                    help="batch*seq kept ~constant across points")
+    args = ap.parse_args()
+
+    from p2pfl_tpu.ops.flash import flash_attention, reference_attention
+
+    key = jax.random.PRNGKey(0)
+    print(f"device={jax.devices()[0].device_kind} h={args.heads} "
+          f"d={args.dim} tokens/step~{args.tokens}", flush=True)
+    print(f"{'seq':>6} {'batch':>6} {'xla_ms':>8} {'flash_ms':>9} "
+          f"{'flash/xla':>9}", flush=True)
+    for s in (int(x) for x in args.seqs.split(",")):
+        b = max(args.tokens // s, 1)
+        q, k, v = (jax.random.normal(key, (b, s, args.heads, args.dim),
+                                     jnp.bfloat16) for _ in range(3))
+
+        def make_body(attn):
+            def loss(q, k, v):
+                return jnp.sum(attn(q, k, v).astype(jnp.float32) ** 2)
+
+            def body(c):
+                q, k, v = c
+                dq, dk, dv = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+                return (q + dq.astype(q.dtype), k + dk.astype(k.dtype),
+                        v + dv.astype(v.dtype))
+
+            return body
+
+        try:
+            t_xla = slope(make_body(reference_attention), (q, k, v))
+        except Exception as e:
+            print(f"{s:>6} xla FAILED {e!r}"[:140], flush=True)
+            continue
+        try:
+            t_fl = slope(make_body(flash_attention), (q, k, v))
+            ratio = t_fl / t_xla
+            print(f"{s:>6} {b:>6} {t_xla:8.2f} {t_fl:9.2f} {ratio:9.2f}",
+                  flush=True)
+        except Exception as e:
+            print(f"{s:>6} {b:>6} {t_xla:8.2f}    FAILED {e!r}"[:140],
+                  flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -574,3 +574,76 @@ def test_run_simulation_inprocess():
     assert out["n_nodes"] == 4 and out["rounds"] == 2
     assert out["round_s"] > 0
     assert out["mean_accuracy"] is None or 0.0 <= out["mean_accuracy"] <= 1.0
+
+
+def test_full_mesh_relay_suppression():
+    """Round-5 socket-path optimization: with ``full_mesh=True``
+    (launcher-declared, topology="fully") a node that links to every
+    other node does NOT re-relay PERIODIC floods (beats, role,
+    progress) — the origin's broadcast already reached everyone, and
+    the relay only multiplies control traffic by the fanout. One-shot
+    floods (STOP here) must still relay: a broken link between two
+    OTHER nodes is locally invisible, and the relay is what delivers
+    across it."""
+
+    async def main():
+        n = 3
+        fed, learners = _make_learners(n)
+        nodes = [
+            P2PNode(i, learners[i], role="aggregator", n_nodes=n,
+                    protocol=_PROTO, gossip_period_s=0.02, full_mesh=True)
+            for i in range(n)
+        ]
+        for node in nodes:
+            await node.start()
+        try:
+            # full wiring: every pair directly connected
+            await nodes[0].connect_to(nodes[1].host, nodes[1].port)
+            await nodes[0].connect_to(nodes[2].host, nodes[2].port)
+            await nodes[1].connect_to(nodes[2].host, nodes[2].port)
+            await asyncio.sleep(0.5)  # beats propagate directly
+            for node in nodes:
+                assert set(node.membership.get_nodes()) == {0, 1, 2}
+            # count frames while the mesh idles on heartbeats: with
+            # suppression each beat costs exactly n-1 sends (origin
+            # only); relaying would add ~fanout x that
+            sent = {i: 0 for i in range(n)}
+            orig_forward = P2PNode._forward
+
+            async def counting_forward(self, msg, exclude=None, limit=0):
+                targets = len(self.peers) if limit <= 0 else min(
+                    limit, len(self.peers))
+                sent[self.idx] += targets
+                await orig_forward(self, msg, exclude=exclude, limit=limit)
+
+            P2PNode._forward = counting_forward
+            try:
+                await asyncio.sleep(1.0)
+            finally:
+                P2PNode._forward = orig_forward
+            total = sum(sent.values())
+            beats = 1.0 / _PROTO.heartbeat_period_s * n  # ~beats sent
+            # suppressed: ~beats * (n-1) origin sends (+ROLE every 2nd
+            # beat); relaying would roughly double that again via
+            # receiver re-forwards. Allow slack for ROLE piggyback.
+            assert total <= beats * (n - 1) * 2.5, (total, beats)
+
+            # degraded mesh: drop 0<->2, node 1 must relay again so
+            # node 0 still learns about node 2's STOP flood
+            conn = nodes[0].peers.pop(2)
+            conn.writer.close()
+            nodes[2].peers.pop(0).writer.close()
+            await asyncio.sleep(0.1)
+            await nodes[2].stop()
+            deadline = asyncio.get_event_loop().time() + 5
+            while (
+                2 in nodes[0].membership.get_nodes()
+                and asyncio.get_event_loop().time() < deadline
+            ):
+                await asyncio.sleep(0.02)
+            assert 2 not in nodes[0].membership.get_nodes()
+        finally:
+            for node in nodes[:2]:
+                await node.stop()
+
+    asyncio.run(main())

@@ -61,3 +61,58 @@ def test_patchconv_gradients_match():
     g_alt = jax.grad(lambda p: loss(alt, p))(params)
     for a, b in zip(jax.tree.leaves(g_ref), jax.tree.leaves(g_alt)):
         assert jnp.max(jnp.abs(a - b)) < 1e-4
+
+
+def test_pre_patchconv_checkpoint_restores_into_patchconv_model(
+        tmp_path, monkeypatch):
+    """VERDICT r4 #8: the checkpoint-compat claim, proven with a real
+    checkpoint. A federation built from the PRE-PatchConv module (both
+    convs as nn.Conv — recreated by disabling the patch gate) is
+    trained a step, checkpointed through federation/checkpoint.py, and
+    restored into the CURRENT PatchConv model. The restored federation
+    must evaluate identically — not just share a param tree."""
+    import numpy as np
+
+    from p2pfl_tpu.federation.checkpoint import (
+        latest_checkpoint,
+        load_checkpoint,
+        save_checkpoint,
+    )
+    from p2pfl_tpu.learning.learner import make_step_fns
+    from p2pfl_tpu.models import cnn as cnn_mod
+    from p2pfl_tpu.parallel.federated import build_eval_fn, init_federation
+
+    n, s = 2, 16
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(n, s, 28, 28, 1)).astype(np.float32)
+    y = rng.integers(0, 62, size=(n, s)).astype(np.int32)
+    mask = np.ones((n, s), bool)
+
+    # the pre-PatchConv module: gate disabled -> conv1 is nn.Conv
+    monkeypatch.setattr(cnn_mod, "PATCH_CONV_MAX_CONTRACTION", 0)
+    old_fns = make_step_fns(get_model("femnist-cnn"), batch_size=8)
+    fed = init_federation(old_fns, jnp.asarray(x[0, :1]), n,
+                          same_init=False)
+    states, _ = jax.vmap(old_fns.train_epochs,
+                         in_axes=(0, 0, 0, 0, None))(
+        fed.states, jnp.asarray(x), jnp.asarray(y), jnp.asarray(mask), 1)
+    fed = fed.replace(states=states, round=fed.round + 1)
+    save_checkpoint(tmp_path, fed)
+    old_eval = build_eval_fn(old_fns)(fed, jnp.asarray(x[0]),
+                                      jnp.asarray(y[0]))
+
+    # restore into the CURRENT (PatchConv) model
+    monkeypatch.setattr(cnn_mod, "PATCH_CONV_MAX_CONTRACTION", 64)
+    new_fns = make_step_fns(get_model("femnist-cnn"), batch_size=8)
+    template = init_federation(new_fns, jnp.asarray(x[0, :1]), n,
+                               same_init=False)
+    restored = load_checkpoint(latest_checkpoint(tmp_path), template)
+    for a, b in zip(jax.tree.leaves(fed.states.params),
+                    jax.tree.leaves(restored.states.params)):
+        assert jnp.array_equal(a, b)
+    new_eval = build_eval_fn(new_fns)(restored, jnp.asarray(x[0]),
+                                      jnp.asarray(y[0]))
+    np.testing.assert_allclose(np.asarray(old_eval["accuracy"]),
+                               np.asarray(new_eval["accuracy"]))
+    np.testing.assert_allclose(np.asarray(old_eval["loss"]),
+                               np.asarray(new_eval["loss"]), rtol=2e-2)

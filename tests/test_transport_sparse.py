@@ -31,8 +31,14 @@ N = 8
 
 @pytest.fixture(scope="module")
 def setup():
+    # easy profile pinned: this file tests COLLECTIVE-SCHEDULE parity,
+    # and the hard surrogate's noisier gradients chaotically amplify
+    # the schedules' benign summation-order epsilon through the second
+    # training round, forcing tolerance inflation that would weaken
+    # the parity claim
     ds = FederatedDataset.make(
-        DataConfig(dataset="mnist", samples_per_node=150), N
+        DataConfig(dataset="mnist", samples_per_node=150,
+                   surrogate_profile="easy"), N
     )
     x, y, smask, nsamp = ds.stacked()
     # deliberately unequal sample counts: weighting parity matters

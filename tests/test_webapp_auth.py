@@ -14,7 +14,7 @@ import pytest
 
 from p2pfl_tpu.users import UserStore
 from p2pfl_tpu.utils.metrics import MetricsLogger
-from p2pfl_tpu.webapp import make_server
+from p2pfl_tpu.webapp import DashboardHandler, make_server
 
 
 # ---- UserStore ----------------------------------------------------------
@@ -71,7 +71,14 @@ class _Browser:
         except urllib.error.HTTPError as e:
             return e.code, e.read().decode()
 
-    def post(self, path, data=None, json_body=None):
+    def post(self, path, data=None, json_body=None, csrf=False):
+        """``csrf=True`` attaches the session's CSRF token the way a
+        served form would (hidden field / JSON key)."""
+        if csrf:
+            if json_body is not None:
+                json_body = {**json_body, "csrf": self.csrf()}
+            else:
+                data = {**(data or {}), "csrf": self.csrf()}
         if json_body is not None:
             body = json.dumps(json_body).encode()
             headers = {"Content-Type": "application/json"}
@@ -85,6 +92,11 @@ class _Browser:
                 return r.status, r.read().decode()
         except urllib.error.HTTPError as e:
             return e.code, e.read().decode()
+
+    def csrf(self):
+        """What the server embeds in this session's forms."""
+        tok = next(c.value for c in self.jar if c.name == "p2pfl_session")
+        return DashboardHandler._derive_csrf(tok)
 
 
 @pytest.fixture()
@@ -114,15 +126,23 @@ def test_login_gates_writes(auth_server):
     code, _ = b.post("/login", {"user": "root", "password": "rootpw"})
     assert code == 200  # opener follows the 303 to /
     assert any(c.name == "p2pfl_session" for c in b.jar)
-    code, body = b.post("/api/scenario/x/stop")
+    # a session cookie alone is NOT enough: cookie-authenticated
+    # state changes need the session's CSRF token (ADVICE r4)
+    code, _ = b.post("/api/scenario/x/stop")
+    assert code == 403
+    code, _ = b.post("/api/scenario/x/stop", {"csrf": "wrong"})
+    assert code == 403
+    code, body = b.post("/api/scenario/x/stop", csrf=True)
     assert code == 200 and json.loads(body)["stopped"] is False
-    # index shows the logged-in identity
+    # index shows the logged-in identity, and its forms embed the token
     _, page = b.get("/")
     assert "logged in as root" in page and "admin" in page
+    _, page = b.get("/admin/users")
+    assert b.csrf() in page
     # logout drops the session
     code, _ = b.post("/logout")
     assert code == 200
-    code, _ = b.post("/api/scenario/x/stop")
+    code, _ = b.post("/api/scenario/x/stop", {"csrf": "x"})
     assert code == 401
 
 
@@ -130,11 +150,11 @@ def test_role_gating_on_user_crud(auth_server):
     viewer = _Browser(auth_server)
     viewer.post("/login", {"user": "viewer", "password": "viewerpw"})
     # non-admin session: deploy-class writes allowed, user CRUD refused
-    code, _ = viewer.post("/api/scenario/x/stop")
+    code, _ = viewer.post("/api/scenario/x/stop", csrf=True)
     assert code == 200
     code, _ = viewer.post("/api/users/add",
                           json_body={"user": "evil", "password": "pw",
-                                     "role": "admin"})
+                                     "role": "admin"}, csrf=True)
     assert code == 401
     code, _ = viewer.get("/admin/users")
     assert code == 401
@@ -143,15 +163,19 @@ def test_role_gating_on_user_crud(auth_server):
     admin.post("/login", {"user": "root", "password": "rootpw"})
     code, page = admin.get("/admin/users")
     assert code == 200 and "viewer" in page
+    # admin session without the CSRF token: still refused
+    code, _ = admin.post("/api/users/add",
+                         json_body={"user": "carol", "password": "pw"})
+    assert code == 403
     code, body = admin.post("/api/users/add",
                             json_body={"user": "carol", "password": "pw",
-                                       "role": "user"})
+                                       "role": "user"}, csrf=True)
     assert code == 200 and json.loads(body)["added"]
     carol = _Browser(auth_server)
     code, _ = carol.post("/login", {"user": "carol", "password": "pw"})
     assert code == 200
     code, body = admin.post("/api/users/remove",
-                            json_body={"user": "carol"})
+                            json_body={"user": "carol"}, csrf=True)
     assert code == 200 and json.loads(body)["removed"]
     # removal kills carol's LIVE session too — no 12h ghost access
     code, _ = carol.post("/api/scenario/x/stop")
@@ -163,6 +187,46 @@ def test_role_gating_on_user_crud(auth_server):
         headers={"Authorization": "Bearer apitoken"}, method="POST")
     with urllib.request.urlopen(req, timeout=10) as r:
         assert r.status == 200
+
+
+def test_read_surface_gated_when_users_configured(auth_server):
+    """ADVICE r4: with a user store, the read surface (index, charts,
+    metrics JSON, log tails) requires a session or the bearer token —
+    the reference gates ALL views behind login (app.py:195-254)."""
+    anon = _Browser(auth_server)
+    # HTML routes bounce to the login page (opener follows the 303)
+    for path in ("/", "/charts/run1", "/scenario/run1", "/designer"):
+        code, page = anon.get(path)
+        assert code == 200 and "action='/login'" in page, path
+    # API routes answer 401 JSON, not a redirect
+    for path in ("/api/scenarios", "/api/metrics/run1",
+                 "/api/download/run1"):
+        code, body = anon.get(path)
+        assert code == 401 and "login required" in body, path
+    # the bearer token still reads (automation)
+    req = urllib.request.Request(auth_server + "/api/scenarios",
+                                 headers={"Authorization": "Bearer apitoken"})
+    with urllib.request.urlopen(req, timeout=10) as r:
+        assert r.status == 200
+    # a logged-in session reads
+    b = _Browser(auth_server)
+    b.post("/login", {"user": "viewer", "password": "viewerpw"})
+    code, page = b.get("/")
+    assert code == 200 and "logged in as viewer" in page
+
+
+def test_read_surface_open_without_user_store(tmp_path):
+    """No --users: token-only servers keep the open read surface
+    (rounds 1-3 behavior; nothing to log in AS)."""
+    srv = make_server(tmp_path, port=0, token="tok")
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        b = _Browser(f"http://127.0.0.1:{srv.server_address[1]}")
+        code, _ = b.get("/api/scenarios")
+        assert code == 200
+    finally:
+        srv.shutdown()
 
 
 def test_oversized_body_rejected(auth_server):

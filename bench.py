@@ -49,9 +49,12 @@ Extra keys in the same JSON line:
   topology, Dirichlet(0.5) non-IID shards, FedAvg;
 - ``vit32_krum_*``: BASELINE.json configs[4] (stretch) — ViT-Tiny, 32
   nodes, Krum aggregator, XLA attention (the faster path at 65-token
-  sequences); ``vit32_flash_*`` re-times the same config through the
-  Pallas flash kernels (``vit32_flash_fault`` records the kernels'
-  known intermittent worker fault, docs/perf.md §5);
+  sequences). The Pallas-flash re-timing (``vit32_flash_*``) is
+  QUARANTINED since round 5 (slower than XLA at every profiled length
+  + intermittent worker fault, docs/perf.md §5b): default artifacts
+  carry ``vit32_flash_quarantined: true`` and no ``vit32_flash_*``
+  keys; set ``P2PFL_BENCH_FLASH=1`` to measure it
+  (``vit32_flash_fault`` / ``vit32_flash_timeout`` recorded);
 - ``cpu8_ring_*``: both collective schedules (dense all-gather einsum
   vs O(degree) ppermute) on an 8-device virtual CPU mesh;
 - ``socket_round_s_24node``: the SOCKET path at 24 nodes (in-process
@@ -544,9 +547,11 @@ def _vit32(timeout_s: float = 1200) -> dict:
        sequence length (65 tokens) plain attention beats the flash
        kernel ~1.8x (flash pads 65 -> 128 blocks and pays the
        lane-replicated stats), and it has no fault history.
-    2. Pallas flash attention (``vit32_flash_*``): exercises ops.flash
-       under Krum on real hardware. The flash kernels retain a low
-       intermittent worker-fault rate (docs/perf.md §5) — the child's
+    2. Pallas flash attention (``vit32_flash_*``): QUARANTINED by
+       default since round 5 — the kernel loses to XLA attention at
+       every profiled sequence length on this chip AND retains the
+       intermittent worker fault (docs/perf.md §5), so the bench only
+       measures it when ``P2PFL_BENCH_FLASH=1``. The child's
        progressive emission keeps whatever it measured, and
        ``vit32_flash_fault`` records a crash.
 
@@ -557,7 +562,16 @@ def _vit32(timeout_s: float = 1200) -> dict:
 
     deadline = time.monotonic() + timeout_s
     merged: dict = {}
-    for use_flash in (False, True):
+    # round-5 quarantine (VERDICT r4 #2): the flash kernel measured
+    # SLOWER than XLA attention at EVERY profiled sequence length on
+    # this chip (1.5-1.7x at seq 1024-4096, scripts/exp_flash_crossover
+    # .py; docs/perf.md §5) while carrying the intermittent worker
+    # fault — a kernel with no demonstrated win does not get to crash
+    # the bench by default. P2PFL_BENCH_FLASH=1 re-enables the
+    # measurement (its child isolation + progressive emission remain).
+    flash_enabled = bool(os.environ.get("P2PFL_BENCH_FLASH"))
+    variants = [False, True] if flash_enabled else [False]
+    for use_flash in variants:
         remaining = deadline - time.monotonic()
         if remaining < 60:
             break
@@ -605,13 +619,18 @@ def _vit32(timeout_s: float = 1200) -> dict:
             merged["vit32_flash_fault"] = bool(rc)
             if timed_out:
                 merged["vit32_flash_timeout"] = True
-    return merged or {"vit32_krum_round_s": None}
+    out = merged or {"vit32_krum_round_s": None}
+    if not flash_enabled:
+        out["vit32_flash_quarantined"] = True
+    return out
 
 
 def _socket24() -> dict:
     """VERDICT r2 #6 metric: steady-state round time of a 24-node
-    SOCKET federation (fully connected, control-flood fan-out capped
-    at 6, binding train-set cap 8) in the in-process simulation mode.
+    SOCKET federation (fully connected, gossip fan-out 12 — raised
+    from 6 in round 5 after relay damping made wide PARAMS fan-out
+    cheap, docs/perf.md §8 — binding train-set cap 8) in the
+    in-process simulation mode.
     Runs on the CPU backend in a subprocess — 24 asyncio nodes cannot
     share the bench chip, and the socket path's cost is control-plane,
     not compute."""
@@ -638,7 +657,12 @@ cfg = ScenarioConfig(
     protocol=ProtocolConfig(heartbeat_period_s=0.5,
                             aggregation_timeout_s=60.0,
                             vote_timeout_s=10.0, train_set_size=8,
-                            gossip_fanout=6),
+                            # fanout 12: with periodic-flood relays
+                            # damped on the declared full mesh, a wider
+                            # fan-out only touches PARAMS gossip and
+                            # one-shot floods — measured 2.9 -> 2.5
+                            # s/round (docs/perf.md §7 sweep)
+                            gossip_fanout=12),
 )
 print("BENCH_SOCK24 " + json.dumps(run_simulation(cfg, timeout=280)))
 """ % (str(__import__("pathlib").Path(__file__).resolve().parent),)

@@ -237,3 +237,37 @@ def test_shared_aggregate_matches_per_row():
                           jax.tree.leaves(fb.states.params)):
             np.testing.assert_allclose(np.asarray(la), np.asarray(lb),
                                        rtol=1e-5, atol=1e-6)
+
+
+def test_identity_adopt_parity_with_dead_node_and_empty_row(setup):
+    """Round-5 fast path: ``identity_adopt=True`` elides the agg[adopt]
+    gather and fuses the keep-select into the FedAvg mix epilogue.
+    It must be BIT-COMPARABLE to the general path on a DFL plan that
+    exercises both select branches: a dead node (frozen params) and a
+    node whose mixing row is all-zero (keeps its own params)."""
+    ds, fns, tr, data, xt, yt = setup
+    topo = generate_topology("ring", N)
+    plan = make_round_plan(topo, ["aggregator"] * N, "DFL")
+    mix = np.asarray(plan.mix).copy()
+    mix[5, :] = 0.0  # node 5: nothing arrives -> keeps its own params
+    plan_args = (
+        tr.put_stacked(jnp.asarray(mix)),
+        tr.put_stacked(jnp.asarray(plan.adopt)),
+        tr.put_stacked(jnp.asarray(plan.trains)),
+    )
+    alive = np.ones(N, bool)
+    alive[2] = False  # dead node: frozen, contributes nothing
+
+    outs = []
+    for ia in (False, True):
+        fed = tr.put_stacked(
+            init_federation(fns, data[0][0, :1], N)
+        ).replace(alive=tr.put_stacked(jnp.asarray(alive)))
+        rf = tr.compile_round(build_round_fn(fns, epochs=1,
+                                             identity_adopt=ia))
+        fed, _ = rf(fed, *data, *plan_args)
+        outs.append(jax.tree.map(np.asarray, fed))
+    ref, fast = outs
+    for a, b in zip(jax.tree.leaves(ref.states.params),
+                    jax.tree.leaves(fast.states.params)):
+        np.testing.assert_array_equal(a, b)

@@ -310,3 +310,27 @@ def test_charts_many_nodes_fold_to_highlight(tmp_path):
     # the muted fold means at most 2 stroke colors besides chrome
     strokes = {part.split("'")[0] for part in svg.split("stroke='")[1:]}
     assert len(strokes - {"none", "#2c2c2a", "#383835"}) <= 2
+
+
+def test_json_array_body_csrf_is_403_not_500(auth_server):
+    """A cookie-authenticated POST with a JSON ARRAY body must fail the
+    CSRF check cleanly (403) — not crash _field with an AttributeError
+    that surfaces as an opaque 500 (round-5 review finding)."""
+    b = _Browser(auth_server)
+    b.post("/login", {"user": "root", "password": "rootpw"})
+    code, body = b.post("/api/scenario/x/stop", json_body=[1, 2, 3])
+    assert code == 403 and "csrf" in body
+
+
+def test_cookie_json_scenario_run_strips_auth_keys(auth_server):
+    """The csrf key riding a cookie-authenticated JSON deploy body must
+    not leak into ScenarioConfig.from_dict (round-5 review finding) —
+    a bad scenario NAME should be the failure, not a TypeError 500."""
+    b = _Browser(auth_server)
+    b.post("/login", {"user": "root", "password": "rootpw"})
+    code, body = b.post("/api/scenario/run",
+                        json_body={"name": "../evil", "n_nodes": 2},
+                        csrf=True)
+    # reaches config parsing + name validation (400), not a csrf
+    # TypeError (500)
+    assert code == 400 and "bad scenario name" in body

@@ -61,13 +61,10 @@ Extra keys in the same JSON line:
   sequences). The ~0.50 at 20 rounds is NOT a stall: FedAvg on the
   identical run reaches only 0.55 on a still-rising curve, and the
   m=1 (0.40) < m=3 (0.50) < mean-family (0.55) ordering is the
-  textbook robust-selection tax (docs/perf.md §6.5). The Pallas-flash
-  re-timing (``vit32_flash_*``) is
-  QUARANTINED since round 5 (slower than XLA at every profiled length
-  + intermittent worker fault, docs/perf.md §5b): default artifacts
-  carry ``vit32_flash_quarantined: true`` and no ``vit32_flash_*``
-  keys; set ``P2PFL_BENCH_FLASH=1`` to measure it
-  (``vit32_flash_fault`` / ``vit32_flash_timeout`` recorded);
+  textbook robust-selection tax (docs/perf.md §6.5). The Pallas flash
+  kernel this phase used to quarantine-gate was REMOVED in round 6
+  (slower than XLA at every profiled length + intermittent worker
+  fault, docs/perf.md §5b);
 - ``cpu8_ring_*``: both collective schedules (dense all-gather einsum
   vs O(degree) ppermute) on an 8-device virtual CPU mesh;
 - ``socket_round_s_24node``: the SOCKET path at 24 nodes (in-process
@@ -408,12 +405,12 @@ def _accuracy_run(run, target: float = 0.80, max_rounds: int = 30,
 
     ``fused=False`` runs the trajectory as per-round dispatches
     instead of one fori_loop program. Round-3 history: the fused
-    composition of the ViT round (Pallas flash + remat + nn.scan) AND
-    its eval intermittently faulted the TPU worker. Round-4 status:
-    the fault is probabilistic (~1 in 6 full executions), not
+    composition of the ViT round (then Pallas flash + remat + nn.scan)
+    AND its eval intermittently faulted the TPU worker. Round-4
+    status: the fault is probabilistic (~1 in 6 full executions), not
     structural — the identical fused program ran clean five times
     (scripts/repro_fused_fault.py; docs/perf.md §5) — so fused is the
-    default, unfused the in-process fallback, and the flash phase's
+    default, unfused the in-process fallback, and the vit32 phase's
     child isolation + progressive emission absorb a recurrence."""
     import jax
     import jax.numpy as jnp
@@ -562,17 +559,16 @@ def _cifar16() -> dict:
         return {"cifar16_dirichlet_round_s": None}
 
 
-def _vit32_inprocess(use_flash: bool) -> None:
+def _vit32_inprocess() -> None:
     """The vit32 measurement body — run in a FRESH process (see
     ``_vit32``), printing a progressive ``BENCH_VIT32 {json}`` line
     after EACH milestone so a later fault cannot zero what was already
-    measured (the flash kernels carry a low but real intermittent
-    worker-fault rate — docs/perf.md §5)."""
+    measured."""
     import json as _json
 
     from p2pfl_tpu.core.aggregators import Krum
 
-    prefix = "vit32_flash" if use_flash else "vit32_krum"
+    prefix = "vit32_krum"
     out: dict = {}
 
     def emit() -> None:
@@ -591,8 +587,7 @@ def _vit32_inprocess(use_flash: bool) -> None:
                  # aggregate instead of 32 redundant ones (whose
                  # transient memory coincided with the round-3 faults)
                  shared_aggregate=True,
-                 model_kwargs={"use_flash": use_flash,
-                               "remat": True,
+                 model_kwargs={"remat": True,
                                "scan_layers": True})
     out[f"{prefix}_round_s"] = round(_time_chained(run, k=5, reps=3), 4)
     out["vit32_synthetic_data"] = run["ds"].synthetic
@@ -621,19 +616,14 @@ def _vit32(timeout_s: float = 1200) -> dict:
     """BASELINE.json configs[4] (stretch): ViT-Tiny, 32 nodes, Krum
     aggregator — on-TPU federation under the robust-aggregation path.
 
-    Two fresh-subprocess measurements, reliable first:
-
-    1. XLA attention (``vit32_krum_*`` — the primary numbers): at this
-       sequence length (65 tokens) plain attention beats the flash
-       kernel ~1.8x (flash pads 65 -> 128 blocks and pays the
-       lane-replicated stats), and it has no fault history.
-    2. Pallas flash attention (``vit32_flash_*``): QUARANTINED by
-       default since round 5 — the kernel loses to XLA attention at
-       every profiled sequence length on this chip AND retains the
-       intermittent worker fault (docs/perf.md §5), so the bench only
-       measures it when ``P2PFL_BENCH_FLASH=1``. The child's
-       progressive emission keeps whatever it measured, and
-       ``vit32_flash_fault`` records a crash.
+    One fresh-subprocess measurement: XLA attention (``vit32_krum_*``)
+    — at this sequence length (65 tokens) plain attention IS the fast
+    path. The Pallas flash kernel this phase used to quarantine-gate
+    was removed in round 6: it measured slower than the XLA block at
+    every profiled shard length (1.5-1.7x at seq 1024-4096) while
+    carrying an intermittent worker fault (docs/perf.md §5b). The
+    child-process isolation + progressive emission remain — they guard
+    against any in-process fault, not just the old kernel's.
 
     ``timeout_s`` is the total budget; this phase runs LAST because it
     is the slowest and riskiest, and gets whatever budget remains."""
@@ -642,50 +632,32 @@ def _vit32(timeout_s: float = 1200) -> dict:
 
     deadline = time.monotonic() + timeout_s
     merged: dict = {}
-    # round-5 quarantine (VERDICT r4 #2): the flash kernel measured
-    # SLOWER than XLA attention at EVERY profiled sequence length on
-    # this chip (1.5-1.7x at seq 1024-4096, scripts/exp_flash_crossover
-    # .py; docs/perf.md §5) while carrying the intermittent worker
-    # fault — a kernel with no demonstrated win does not get to crash
-    # the bench by default. P2PFL_BENCH_FLASH=1 re-enables the
-    # measurement (its child isolation + progressive emission remain).
-    flash_enabled = os.environ.get("P2PFL_BENCH_FLASH", "").lower() in (
-        "1", "true", "yes")
-    variants = [False, True] if flash_enabled else [False]
-    for use_flash in variants:
-        remaining = deadline - time.monotonic()
-        if remaining < 60:
-            break
+    remaining = deadline - time.monotonic()
+    if remaining >= 60:
         code = (
             f"import sys; sys.path.insert(0, {_REPO!r})\n"
             "import bench\n"
-            f"bench._vit32_inprocess({use_flash!r})\n"
+            "bench._vit32_inprocess()\n"
         )
         last = None
-        rc = None
-        timed_out = False
         try:
             res = subprocess.run([sys.executable, "-c", code],
                                  capture_output=True, text=True,
                                  timeout=remaining)
-            rc = res.returncode
             stdout = res.stdout
-            if rc != 0:
-                print(f"vit32 child (use_flash={use_flash}) rc={rc}: "
+            if res.returncode != 0:
+                print(f"vit32 child rc={res.returncode}: "
                       f"{res.stderr[-400:]}", file=sys.stderr)
         except subprocess.TimeoutExpired as e:
             # the child's progressive lines are in e.stdout — a budget
             # kill must not zero what the child already measured
-            timed_out = True
             stdout = e.stdout or b""
             if isinstance(stdout, bytes):
                 stdout = stdout.decode(errors="replace")
-            print(f"vit32 child (use_flash={use_flash}) hit the phase "
-                  "budget", file=sys.stderr)
+            print("vit32 child hit the phase budget", file=sys.stderr)
         except Exception as e:
             stdout = ""
-            print(f"vit32 child (use_flash={use_flash}) failed: {e!r}",
-                  file=sys.stderr)
+            print(f"vit32 child failed: {e!r}", file=sys.stderr)
         for line in stdout.splitlines():
             if line.startswith("BENCH_VIT32 "):
                 last = line[len("BENCH_VIT32 "):]
@@ -694,16 +666,7 @@ def _vit32(timeout_s: float = 1200) -> dict:
                 merged.update(_json.loads(last))
             except _json.JSONDecodeError:
                 pass
-        if use_flash:
-            # a budget kill is NOT a kernel fault — the artifact tracks
-            # the kernels' fault rate, so the two must stay distinct
-            merged["vit32_flash_fault"] = bool(rc)
-            if timed_out:
-                merged["vit32_flash_timeout"] = True
-    out = merged or {"vit32_krum_round_s": None}
-    if not flash_enabled:
-        out["vit32_flash_quarantined"] = True
-    return out
+    return merged or {"vit32_krum_round_s": None}
 
 
 def _socket24() -> dict:
@@ -824,6 +787,12 @@ def _phase_headline() -> None:
     except Exception as e:
         print(f"device-slope timing failed: {e!r}"[:200], file=sys.stderr,
               flush=True)
+    # the measured per-op kernel-vs-XLA table behind this run's hot
+    # path (docs/perf.md §6.4) — records WHICH impl ran and why, so
+    # the headline MFU is auditable against the gate's measurements
+    from p2pfl_tpu.ops import pallas_gemm
+
+    part["pallas_gemm_decisions"] = pallas_gemm.decisions()
     _part(part)
 
     # each remaining part is independently guarded: a trajectory

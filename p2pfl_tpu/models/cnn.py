@@ -14,6 +14,7 @@ import jax
 import jax.numpy as jnp
 
 from p2pfl_tpu.models.base import register_model
+from p2pfl_tpu.ops import pallas_gemm
 
 #: contraction size (C_in * k * k) at or below which a conv runs as
 #: patches + matmul instead of lax.conv. The federation vmaps per-node
@@ -58,12 +59,55 @@ class PatchConv(nn.Module):
         # are (kh, kw, cin) -> transpose before flattening to match
         wf = (w.astype(dtype)
               .transpose(2, 0, 1, 3).reshape(cin * kh * kw, self.features))
-        out = patches @ wf
+        # the GEMM itself routes through the measured gate: Pallas
+        # streams M over a VMEM-stationary [K, N] weight tile (fwd,
+        # dgrad, wgrad — docs/perf.md §6.4), XLA otherwise. Bias and
+        # the downstream relu/pool stay XLA either way: they fuse into
+        # the pooling pass, so the kernel saves nothing by absorbing
+        # them.
+        flat = patches.reshape(-1, cin * kh * kw)
+        if pallas_gemm.choose("patches", (flat.shape, wf.shape),
+                              dtype) == "pallas":
+            out = pallas_gemm.patches_matmul(flat, wf)
+        else:
+            out = flat @ wf
+        out = out.reshape(patches.shape[:-1] + (self.features,))
         if self.use_bias:
             b = self.param("bias", nn.initializers.zeros,
                            (self.features,), self.param_dtype)
             out = out + b.astype(dtype)
         return out
+
+
+class GatedDense(nn.Module):
+    """nn.Dense-compatible layer whose BACKWARD routes through the
+    measured Pallas gate.
+
+    Same parameter tree, init and forward math as ``nn.Dense`` (XLA
+    forward — it sits near its floor); when the gate picks Pallas the
+    backward runs the fused dgrad+wgrad kernel (one streaming pass
+    over activations and weight, cotangent VMEM-stationary) instead of
+    XLA's two independent GEMMs — the dense1 half of perf.md §6.4.
+    """
+
+    features: int
+    dtype: jnp.dtype | None = None
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        dtype = self.dtype or x.dtype
+        k = self.param("kernel", nn.initializers.lecun_normal(),
+                       (x.shape[-1], self.features), self.param_dtype)
+        b = self.param("bias", nn.initializers.zeros,
+                       (self.features,), self.param_dtype)
+        x, k = x.astype(dtype), k.astype(dtype)
+        if pallas_gemm.choose("dense_bwd", (x.shape, k.shape),
+                              dtype) == "pallas":
+            out = pallas_gemm.dense_matmul(x, k)
+        else:
+            out = x @ k
+        return out + b.astype(dtype)
 
 
 class SmallCNN(nn.Module):
@@ -97,10 +141,14 @@ class SmallCNN(nn.Module):
             x = nn.relu(x)
             x = nn.max_pool(x, (2, 2), strides=(2, 2))
         x = x.reshape((x.shape[0], -1))
-        x = nn.Dense(self.hidden, dtype=self.dtype, param_dtype=self.param_dtype)(x)
+        # explicit name= keeps the tree keyed Dense_0/Dense_1 as the
+        # nn.Dense auto-naming did (same rationale as Conv_N above);
+        # dense1's backward is the gated Pallas hot path
+        x = GatedDense(self.hidden, dtype=self.dtype,
+                       param_dtype=self.param_dtype, name="Dense_0")(x)
         x = nn.relu(x)
         x = nn.Dense(self.num_classes, dtype=self.dtype,
-                     param_dtype=self.param_dtype)(x)
+                     param_dtype=self.param_dtype, name="Dense_1")(x)
         return x.astype(jnp.float32)
 
 

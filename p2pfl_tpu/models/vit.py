@@ -25,7 +25,6 @@ class TransformerBlock(nn.Module):
     dtype: jnp.dtype = jnp.bfloat16
     param_dtype: jnp.dtype = jnp.float32
     seq_axis: str | None = None  # mesh axis for ring attention
-    use_flash: bool = False  # Pallas fused local attention (ops.flash)
 
     def _qkv(self, y):
         head = (self.heads, self.dim // self.heads)
@@ -38,17 +37,12 @@ class TransformerBlock(nn.Module):
     @nn.compact
     def __call__(self, x):
         y = nn.LayerNorm(dtype=self.dtype, param_dtype=self.param_dtype)(x)
-        if self.seq_axis is not None or self.use_flash:
-            if self.seq_axis is not None:
-                from p2pfl_tpu.ops.ring_attention import ring_self_attention
+        if self.seq_axis is not None:
+            from p2pfl_tpu.ops.ring_attention import ring_self_attention
 
-                attn = lambda q, k, v: ring_self_attention(
-                    q, k, v, axis_name=self.seq_axis
-                )
-            else:
-                from p2pfl_tpu.ops.flash import flash_attention
-
-                attn = flash_attention
+            attn = lambda q, k, v: ring_self_attention(
+                q, k, v, axis_name=self.seq_axis
+            )
             y = attn(*self._qkv(y))
             y = nn.DenseGeneral(self.dim, axis=(-2, -1), dtype=self.dtype,
                                 param_dtype=self.param_dtype, name="out")(y)
@@ -89,10 +83,6 @@ class ViT(nn.Module):
     dtype: jnp.dtype = jnp.bfloat16
     param_dtype: jnp.dtype = jnp.float32
     seq_axis: str | None = None
-    use_flash: bool = False  # pair with remat=True at federation scale:
-    # the flash kernels save lane-replicated (128x) softmax stats as
-    # backward residuals (ops/flash.py _STATS_LANES); remat recomputes
-    # them per block instead of holding nodes x batch x heads of them
     remat: bool = False  # jax.checkpoint each block: trade recompute
     # for ~depth x less activation memory — lets a federation of many
     # ViT replicas (vmapped per-node weights) fit a single chip's HBM
@@ -116,7 +106,7 @@ class ViT(nn.Module):
         block_cls = nn.remat(TransformerBlock) if self.remat else TransformerBlock
         block_kw = dict(dim=self.dim, heads=self.heads, dtype=self.dtype,
                         param_dtype=self.param_dtype,
-                        seq_axis=self.seq_axis, use_flash=self.use_flash)
+                        seq_axis=self.seq_axis)
         if self.scan_layers:
             scanned = nn.scan(
                 _BlockStep,

@@ -6,20 +6,26 @@ keeps its hot custom ops:
 
 - ``ring_attention``: blockwise self-attention with K/V rotation via
   ``ppermute`` over a mesh axis — sequence/context parallelism for the
-  long-context path (ViT & transformer workloads).
+  long-context path (ViT & transformer workloads). The on-device block
+  (and the parity oracle) is ``reference_attention``: plain XLA
+  softmax attention, which measured FASTER than a hand-tiled Pallas
+  flash kernel at every shard length tried (docs/perf.md §5b) — the
+  kernel was removed in round 6.
 - ``ulysses_attention``: all-to-all (DeepSpeed-Ulysses-style) sequence
   parallelism — heads sharded during attention, sequence sharded
   elsewhere.
-- ``flash_attention``: Pallas fused attention kernel for the on-device
-  block — O(block) memory, streaming K/V through VMEM with running
-  softmax stats; shape-guarded fallback to the XLA path.
+- ``pallas_gemm``: hand-tiled GEMM kernels for the FEMNIST round's
+  over-floor hot ops (conv1 patches GEMM, dense1 backward) behind a
+  measured auto-select gate with XLA fallback (docs/perf.md §6.4).
 """
 
-from p2pfl_tpu.ops.flash import flash_attention, reference_attention
-from p2pfl_tpu.ops.ring_attention import ring_self_attention, ulysses_attention
+from p2pfl_tpu.ops.ring_attention import (
+    reference_attention,
+    ring_self_attention,
+    ulysses_attention,
+)
 
 __all__ = [
-    "flash_attention",
     "reference_attention",
     "ring_self_attention",
     "ulysses_attention",

@@ -133,8 +133,8 @@ def _kernel(bx_ref, by_ref,
     omb1_ref[:] = c1.astype(omb1_ref.dtype)
     omw2_ref[:] = m2.astype(omw2_ref.dtype)
     omb2_ref[:] = c2.astype(omb2_ref.dtype)
-    # lane-replicated scalar (see ops.flash: degenerate lane-1 layouts
-    # are the fragile path on Mosaic)
+    # lane-replicated scalar (degenerate lane-1 layouts are the
+    # fragile path on Mosaic)
     loss_ref[:] = jnp.full(loss_ref.shape, loss_sum / steps,
                            loss_ref.dtype)
 

@@ -1,0 +1,452 @@
+"""Hand-tiled Pallas GEMM kernels for the ops furthest over their
+derived floors (docs/perf.md §6.2): conv1's patches GEMM and dense1's
+backward.
+
+Why these two: the §6.2 ceiling table puts the headline FEMNIST round
+at 17.9% device-true MFU against a 31% achievable ceiling, and the
+overage is concentrated in (a) conv1's `[M≈263k, 25] @ [25, 32]`
+patches matmul (13.3 ms measured vs a 2.8 ms floor — XLA's grouped /
+small-tile lowering, not the MXU tile fill, is what loses the 4.7x)
+and (b) dense1's backward (7.5 ms vs 2.9 ms — two separate XLA GEMMs
+re-streaming the [3136, 2048] weight and both activations through
+HBM). Neither kernel can beat the MXU's 128-lane tile fill — the
+floors already price that in — so the target is XLA's overhead above
+the floor, not the floor itself.
+
+Kernel shapes (per federated node; the federation's `vmap` over the
+node axis batches `pallas_call` by prepending a grid dimension, so
+kernels are written 2-D):
+
+- ``stream_gemm``: ``[M, K] @ [K, N]`` with K, N small (≤128 each,
+  i.e. one MXU tile). The weight stays VMEM-stationary across the
+  whole grid; M streams through in ``block_m`` row tiles. Covers
+  conv1 fwd (``patches @ wf``) and conv1 dgrad (``g @ wf^T`` — same
+  shape class with K and N swapped).
+- ``stream_wgrad``: ``[M, K]^T @ [M, N] -> [K, N]`` — M-streamed
+  accumulation into a stationary f32 output block. Covers conv1
+  wgrad. Ragged-edge M tiles mask BOTH operands: an out-of-bounds
+  block row may read garbage (even NaN), and ``NaN * 0 = NaN`` would
+  poison the accumulator if only one side were zeroed.
+- ``_dense_bwd_kernel``: fused dgrad+wgrad for ``y = x @ w`` — grid
+  over the contraction-free ``d_in`` axis with the cotangent
+  VMEM-stationary, producing ``dx`` and ``dw`` tiles from one pass
+  over ``x`` and ``w`` (one HBM read of each instead of XLA's two
+  independent GEMMs).
+
+Selection: every call site asks :func:`choose`, which measures the
+Pallas and XLA variants at the actual (vmapped) shape on the real
+backend — scan-slope timing, same methodology as
+``scripts/exp_ceiling.py`` — caches the verdict per shape, and falls
+back to XLA whenever Pallas does not win. ``P2PFL_PALLAS_GEMM``
+(auto|on|off) forces either path; non-TPU backends always take XLA
+(interpret-mode Pallas is a correctness tool, not a fast path). The
+decision table is exported into the bench output
+(``pallas_gemm_decisions``) so every headline run records the
+before/after per-op numbers that justified its path.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "patches_matmul",
+    "dense_matmul",
+    "stream_gemm",
+    "stream_wgrad",
+    "dense_bwd",
+    "choose",
+    "decisions",
+    "set_nodes_hint",
+    "clear_cache",
+]
+
+#: env knob: "auto" (measure, default), "on"/"pallas" (force kernels),
+#: "off"/"xla" (force XLA). Documented in README + docs/perf.md §6.4.
+ENV_KNOB = "P2PFL_PALLAS_GEMM"
+
+_BLOCK_M = 2048  # M rows per grid step (conv1: 129 tiles of 263424)
+_BLOCK_D = 448   # d_in rows per dense-bwd grid step (7 x 448 = 3136)
+
+
+def _interp(interpret):
+    if interpret is None:
+        return jax.default_backend() == "cpu"
+    return bool(interpret)
+
+
+def _dot(a, b, dims):
+    return jax.lax.dot_general(a, b, (dims, ((), ())),
+                               preferred_element_type=jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# stream_gemm: [M, K] @ [K, N], weight stationary, M streamed
+# ---------------------------------------------------------------------------
+
+
+def _gemm_kernel(x_ref, w_ref, o_ref):
+    o_ref[:] = _dot(x_ref[:], w_ref[:], ((1,), (0,))).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnums=(2, 3))
+def _stream_gemm(x, w, block_m, interpret):
+    import jax.experimental.pallas as pl
+
+    m, k = x.shape
+    n = w.shape[1]
+    bm = min(block_m, m)
+    out = pl.pallas_call(
+        _gemm_kernel,
+        grid=(pl.cdiv(m, bm),),
+        in_specs=[
+            pl.BlockSpec((bm, k), lambda i: (i, 0)),
+            pl.BlockSpec((k, n), lambda i: (0, 0)),  # stationary
+        ],
+        out_specs=pl.BlockSpec((bm, n), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((m, n), x.dtype),
+        interpret=interpret,
+    )(x, w)
+    return out
+
+
+def stream_gemm(x, w, *, block_m: int = _BLOCK_M,
+                interpret: bool | None = None):
+    """``x [M, K] @ w [K, N]`` with w VMEM-stationary, f32 accumulate.
+
+    Raw kernel (no custom VJP) — the building block for
+    :func:`patches_matmul`'s forward and dgrad.
+    """
+    return _stream_gemm(x, w, int(block_m), _interp(interpret))
+
+
+# ---------------------------------------------------------------------------
+# stream_wgrad: x^T @ g accumulated over M tiles into a stationary block
+# ---------------------------------------------------------------------------
+
+
+def _wgrad_kernel(x_ref, g_ref, o_ref, *, m_total, block_m):
+    import jax.experimental.pallas as pl
+
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        o_ref[:] = jnp.zeros_like(o_ref)
+
+    # ragged edge: mask BOTH operands — out-of-bounds block rows are
+    # unspecified (possibly NaN) and NaN * 0 = NaN would poison the
+    # accumulator through either side of the dot
+    rows = jax.lax.broadcasted_iota(jnp.int32, (x_ref.shape[0], 1), 0)
+    ok = rows + i * block_m < m_total
+    x = jnp.where(ok, x_ref[:], 0)
+    g = jnp.where(ok, g_ref[:], 0)
+    o_ref[:] += _dot(x, g, ((0,), (0,))).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnums=(2, 3))
+def _stream_wgrad(x, g, block_m, interpret):
+    import jax.experimental.pallas as pl
+
+    m, k = x.shape
+    n = g.shape[1]
+    bm = min(block_m, m)
+    out = pl.pallas_call(
+        functools.partial(_wgrad_kernel, m_total=m, block_m=bm),
+        grid=(pl.cdiv(m, bm),),
+        in_specs=[
+            pl.BlockSpec((bm, k), lambda i: (i, 0)),
+            pl.BlockSpec((bm, n), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((k, n), lambda i: (0, 0)),  # stationary
+        out_shape=jax.ShapeDtypeStruct((k, n), jnp.float32),
+        interpret=interpret,
+    )(x, g)
+    return out
+
+
+def stream_wgrad(x, g, *, block_m: int = _BLOCK_M,
+                 interpret: bool | None = None):
+    """``x [M, K]^T @ g [M, N] -> [K, N]`` f32, M-streamed accumulate."""
+    return _stream_wgrad(x, g, int(block_m), _interp(interpret))
+
+
+# ---------------------------------------------------------------------------
+# patches_matmul: stream_gemm with a Pallas VJP (conv1 fwd + dgrad + wgrad)
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def _patches_mm(x, w, block_m, interpret):
+    return _stream_gemm(x, w, block_m, interpret)
+
+
+def _patches_mm_fwd(x, w, block_m, interpret):
+    return _patches_mm(x, w, block_m, interpret), (x, w)
+
+
+def _patches_mm_bwd(block_m, interpret, res, g):
+    x, w = res
+    # dgrad is the same small-tile shape class ([M, N] @ [N, K]);
+    # dead-code eliminated when x is a non-differentiated input
+    # (conv1: the image layer needs no dx)
+    dx = _stream_gemm(g, w.T, block_m, interpret).astype(x.dtype)
+    dw = _stream_wgrad(x, g, block_m, interpret).astype(w.dtype)
+    return dx, dw
+
+
+_patches_mm.defvjp(_patches_mm_fwd, _patches_mm_bwd)
+
+
+def patches_matmul(x, w, *, block_m: int = _BLOCK_M,
+                   interpret: bool | None = None):
+    """``x [M, K] @ w [K, N]`` (K, N ≤ 128) — Pallas fwd, dgrad and
+    wgrad. The conv1 hot path: patches flattened to 2-D rows."""
+    if x.ndim != 2 or w.ndim != 2:
+        raise ValueError(f"2-D operands required, got {x.shape} @ {w.shape}")
+    return _patches_mm(x, w, int(block_m), _interp(interpret))
+
+
+# ---------------------------------------------------------------------------
+# dense_bwd: fused dgrad + wgrad for y = x @ w (dense1 backward)
+# ---------------------------------------------------------------------------
+
+
+def _dense_bwd_kernel(g_ref, x_ref, w_ref, dx_ref, dw_ref):
+    # g [B, H] stationary; x [B, TD], w [TD, H] stream over d_in.
+    # Contractions run over full axes (B, H) — a ragged d_in edge only
+    # produces garbage in output rows/columns the BlockSpec masks off
+    # on write, so no operand masking is needed here.
+    g = g_ref[:]
+    dx_ref[:] = _dot(g, w_ref[:], ((1,), (1,))).astype(dx_ref.dtype)
+    dw_ref[:] = _dot(x_ref[:], g, ((0,), (0,))).astype(dw_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnums=(3, 4))
+def _dense_bwd(x, w, g, block_d, interpret):
+    import jax.experimental.pallas as pl
+
+    b, d_in = x.shape
+    h = w.shape[1]
+    bd = min(block_d, d_in)
+    dx, dw = pl.pallas_call(
+        _dense_bwd_kernel,
+        grid=(pl.cdiv(d_in, bd),),
+        in_specs=[
+            pl.BlockSpec((b, h), lambda i: (0, 0)),  # cotangent stationary
+            pl.BlockSpec((b, bd), lambda i: (0, i)),
+            pl.BlockSpec((bd, h), lambda i: (i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((b, bd), lambda i: (0, i)),
+            pl.BlockSpec((bd, h), lambda i: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, d_in), x.dtype),
+            jax.ShapeDtypeStruct((d_in, h), w.dtype),
+        ],
+        interpret=interpret,
+    )(g, x, w)
+    return dx, dw
+
+
+def dense_bwd(x, w, g, *, block_d: int = _BLOCK_D,
+              interpret: bool | None = None):
+    """Fused backward of ``y = x @ w``: ``(dx, dw)`` from one pass
+    over x and w (cotangent ``g`` VMEM-stationary)."""
+    return _dense_bwd(x, w, g, int(block_d), _interp(interpret))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def _dense_mm(x, w, block_d, interpret):
+    # forward stays XLA — it sits near its floor (§6.2); only the
+    # backward is over-floor enough to pay for a kernel
+    return _dot(x, w, ((1,), (0,))).astype(x.dtype)
+
+
+def _dense_mm_fwd(x, w, block_d, interpret):
+    return _dense_mm(x, w, block_d, interpret), (x, w)
+
+
+def _dense_mm_bwd(block_d, interpret, res, g):
+    x, w = res
+    dx, dw = _dense_bwd(x, w, g.astype(x.dtype), block_d=block_d,
+                        interpret=interpret)
+    return dx, dw
+
+
+_dense_mm.defvjp(_dense_mm_fwd, _dense_mm_bwd)
+
+
+def dense_matmul(x, w, *, block_d: int = _BLOCK_D,
+                 interpret: bool | None = None):
+    """``x [B, D] @ w [D, H]`` — XLA forward, fused Pallas backward."""
+    if x.ndim != 2 or w.ndim != 2:
+        raise ValueError(f"2-D operands required, got {x.shape} @ {w.shape}")
+    return _dense_mm(x, w, int(block_d), _interp(interpret))
+
+
+# ---------------------------------------------------------------------------
+# measured auto-select gate
+# ---------------------------------------------------------------------------
+
+_decisions: dict[str, dict] = {}
+_nodes_hint: int = 1
+
+
+def set_nodes_hint(n: int) -> None:
+    """Tell the gate how wide the federation's node vmap is — the
+    microbenchmark measures the batched shape actually run. Called by
+    ``parallel.federated.init_federation``; defaults to 1 (single
+    learner)."""
+    global _nodes_hint
+    _nodes_hint = max(int(n), 1)
+
+
+def decisions() -> dict[str, dict]:
+    """JSON-able copy of every gate decision this process made
+    (impl, measured ms per variant, forcing). Exported by bench.py."""
+    return {k: dict(v) for k, v in _decisions.items()}
+
+
+def clear_cache() -> None:
+    _decisions.clear()
+
+
+def _slope_ms(fn, args, r1: int = 2, r2: int = 6) -> float:
+    """Per-call ms net of dispatch/sync overhead: time a scan of r2
+    repeats minus a scan of r1 repeats over (r2 - r1) — the
+    scripts/exp_ceiling.py scan-slope methodology."""
+
+    def repeat(reps):
+        @jax.jit
+        def run(x0, *rest):
+            def body(x, _):
+                out = fn(x, *rest)
+                first = jax.tree.leaves(out)[0]
+                # fold one element back into the carry so scan cannot
+                # hoist or elide the repeated call
+                return x + (first.reshape(-1)[0] * 0).astype(x.dtype), None
+
+            return jax.lax.scan(body, x0, None, length=reps)[0]
+
+        run(*args).block_until_ready()  # compile + warm
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            run(*args).block_until_ready()
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    return max((repeat(r2) - repeat(r1)) / (r2 - r1) * 1e3, 0.0)
+
+
+def _measure(kind: str, key: str, pallas_fn, xla_fn, args) -> str:
+    try:
+        p_ms = _slope_ms(pallas_fn, args)
+        x_ms = _slope_ms(xla_fn, args)
+    except Exception as e:  # Mosaic lowering/launch failure -> XLA
+        _decisions[key] = {"kind": kind, "impl": "xla", "forced": False,
+                           "error": f"{type(e).__name__}: {e}"}
+        return "xla"
+    impl = "pallas" if p_ms < x_ms else "xla"
+    _decisions[key] = {"kind": kind, "impl": impl, "forced": False,
+                       "pallas_ms": round(p_ms, 4), "xla_ms": round(x_ms, 4)}
+    return impl
+
+
+def choose(kind: str, shapes: tuple, dtype) -> str:
+    """Pick "pallas" or "xla" for one op instance.
+
+    ``kind``: "patches" (conv1 fwd+bwd GEMM) or "dense_bwd" (dense1
+    fused backward). ``shapes``: the per-node operand shapes as seen
+    at the call site. Measured decisions are cached per (kind, shapes,
+    dtype, nodes, backend); env/backend forcings are recorded too so
+    the bench table shows WHY a path ran.
+    """
+    backend = jax.default_backend()
+    dt = jnp.dtype(dtype).name
+    n = _nodes_hint
+    key = f"{kind} n{n} {'x'.join(map(str, shapes[0]))}@" \
+          f"{'x'.join(map(str, shapes[1]))} {dt} {backend}"
+    cached = _decisions.get(key)
+    if cached is not None:
+        return cached["impl"]
+
+    env = os.environ.get(ENV_KNOB, "auto").strip().lower()
+    if env in ("off", "0", "xla", "false"):
+        _decisions[key] = {"kind": kind, "impl": "xla", "forced": True,
+                           "reason": f"{ENV_KNOB}={env}"}
+    elif env in ("on", "1", "pallas", "true"):
+        _decisions[key] = {"kind": kind, "impl": "pallas", "forced": True,
+                           "reason": f"{ENV_KNOB}={env}"}
+    elif backend != "tpu":
+        # interpret-mode Pallas is for parity testing, never for speed
+        _decisions[key] = {"kind": kind, "impl": "xla", "forced": True,
+                           "reason": f"backend={backend}"}
+    elif _flops(kind, shapes) * n < _MIN_GATE_FLOPS:
+        # don't burn measurement time on trivial instances (model.init
+        # traces with batch 1; tiny eval shapes) — XLA is fine there
+        _decisions[key] = {"kind": kind, "impl": "xla", "forced": True,
+                           "reason": "below measurement threshold"}
+    else:
+        return _measure_kind(kind, key, shapes, dtype, n)
+    return _decisions[key]["impl"]
+
+
+_MIN_GATE_FLOPS = 1e8  # per-instance GEMM flops worth measuring
+
+
+def _flops(kind, shapes) -> float:
+    (m, k), (_, n_out) = shapes
+    mult = 2.0 if kind == "dense_bwd" else 1.0  # bwd = two GEMMs
+    return 2.0 * m * k * n_out * mult
+
+
+def _measure_kind(kind: str, key: str, shapes, dtype, n) -> str:
+    if kind == "patches":
+        (m, k), (_, out_n) = shapes
+        x = jnp.zeros((n, m, k), dtype)
+        w = jnp.zeros((n, k, out_n), dtype)
+
+        def pallas_fn(x, w):
+            f = lambda a, b: patches_matmul(a, b)
+            return _grad_through(jax.vmap(f))(x, w)
+
+        def xla_fn(x, w):
+            f = lambda a, b: _dot(a, b, ((1,), (0,))).astype(a.dtype)
+            return _grad_through(jax.vmap(f))(x, w)
+
+        return _measure(kind, key, pallas_fn, xla_fn, (x, w))
+    if kind == "dense_bwd":
+        (b, d_in), (_, h) = shapes
+        x = jnp.zeros((n, b, d_in), dtype)
+        w = jnp.zeros((n, d_in, h), dtype)
+
+        def pallas_fn(x, w):
+            f = lambda a, b: dense_matmul(a, b)
+            return _grad_through(jax.vmap(f))(x, w)
+
+        def xla_fn(x, w):
+            f = lambda a, b: _dot(a, b, ((1,), (0,))).astype(a.dtype)
+            return _grad_through(jax.vmap(f))(x, w)
+
+        return _measure(kind, key, pallas_fn, xla_fn, (x, w))
+    raise ValueError(f"unknown gate kind: {kind!r}")
+
+
+def _grad_through(f):
+    """Measure fwd+bwd together — the gate's question is the round's
+    train step, which always differentiates these ops."""
+
+    def g(x, w):
+        loss = lambda a, b: jnp.sum(f(a, b).astype(jnp.float32))
+        dx, dw = jax.grad(loss, argnums=(0, 1))(x, w)
+        return dx
+
+    return g

@@ -395,17 +395,22 @@ class P2PNode:
             # but a DEAD A-B link with both ends otherwise fully
             # connected is invisible to the relaying third party C
             # (C still has n-1 peers), and C's relay is the only path
-            # keeping A/B from falsely evicting each other. So periodic
-            # floods relay at 10% instead of 0%: ~90% of the measured
-            # relay traffic gone, while a beat still crosses a broken
-            # link within a few periods (well inside node_timeout_s).
+            # keeping A/B from falsely evicting each other. The relay
+            # probability scales with the mesh so the EXPECTED number
+            # of repair relays per beat stays ~1 regardless of n:
+            # p = min(1, 1/(n-2)) over the n-2 third parties. At n=3
+            # the lone third party always relays (a flat rate would
+            # leave a severed A-B pair waiting ~1/p beats per crossing
+            # and false-evicting inside node_timeout_s); at n=24 this
+            # is ~0.045 — the measured relay traffic stays >95% gone.
             # One-shot floods (STOP, votes, leadership) always relay.
             # The peer-count guard restores full relaying whenever this
             # node's own links are down.
+            relay_p = min(1.0, 1.0 / max(self.n_nodes - 2, 1))
             damped = (self.full_mesh
                       and msg.type in PERIODIC_FLOODS
                       and len(self.peers) >= self.n_nodes - 1
-                      and self._rng.random() >= 0.1)
+                      and self._rng.random() >= relay_p)
             if not damped:
                 await self._forward(msg, exclude=peer.idx,
                                     limit=self.protocol.gossip_fanout)

@@ -137,6 +137,12 @@ def init_federation(
     """Stacked init. ``same_init=True`` reproduces the reference's
     initial-model diffusion (node.py:299: every node starts from the
     initializer's weights) without the gossip: init once, broadcast."""
+    # the pallas_gemm auto-select gate measures candidate kernels at
+    # the VMAPPED shape — tell it the federation width before any
+    # model application traces (docs/perf.md §6.4)
+    from p2pfl_tpu.ops import pallas_gemm
+
+    pallas_gemm.set_nodes_hint(n_nodes)
     rngs = (
         jnp.stack([jax.random.PRNGKey(seed)] * n_nodes)
         if same_init
@@ -334,10 +340,9 @@ def build_round_fn_sparse(
     round instead of n × |params| — the reference's per-neighbor TCP
     sends (node.py:726-809) become exactly #offsets ppermutes.
     """
-    from jax import shard_map
     from jax.sharding import PartitionSpec
 
-    from p2pfl_tpu.parallel.mesh import NODES_AXIS
+    from p2pfl_tpu.parallel.mesh import NODES_AXIS, shard_map_compat
     from p2pfl_tpu.parallel.transport import neighbor_exchange
 
     if topology.n != mesh.size:
@@ -379,12 +384,11 @@ def build_round_fn_sparse(
         metrics = {"train_loss": train_metrics["loss"], "alive": alive}
         return fed, metrics
 
-    sharded = shard_map(
+    sharded = shard_map_compat(
         round_body,
         mesh=mesh,
         in_specs=(fed_spec, Pn, Pn, Pn, Pn, Pn, Pn, Pn),
         out_specs=(fed_spec, {"train_loss": Pn, "alive": Pn}),
-        check_vma=False,
     )
     return sharded
 

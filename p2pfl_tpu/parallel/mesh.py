@@ -48,6 +48,24 @@ def shard_stacked(tree, mesh: Mesh):
     return jax.tree.map(lambda x: jax.device_put(x, sh), tree)
 
 
+def shard_map_compat(f, mesh: Mesh, in_specs, out_specs):
+    """``shard_map`` across JAX versions: the top-level export (and its
+    ``check_vma`` flag) only exist on newer JAX; 0.4.x has
+    ``jax.experimental.shard_map`` with ``check_rep``. Replication
+    checking is disabled on both — the round programs mix collectives
+    the checker rejects spuriously."""
+    try:
+        from jax import shard_map  # new JAX
+
+        return shard_map(f, mesh=mesh, in_specs=in_specs,
+                         out_specs=out_specs, check_vma=False)
+    except ImportError:
+        from jax.experimental.shard_map import shard_map
+
+        return shard_map(f, mesh=mesh, in_specs=in_specs,
+                         out_specs=out_specs, check_rep=False)
+
+
 def fetch_global(x) -> np.ndarray:
     """Device array -> full host copy, valid on EVERY process of a
     multi-process job — including processes that own no device of the
@@ -63,15 +81,28 @@ def fetch_global(x) -> np.ndarray:
     sharded) and ``broadcast_one_to_all`` — a true global collective —
     ships process 0's copy everywhere (process 0 owns mesh device 0 by
     construction, so it always has the value).
+
+    Every branch below that leads to a COLLECTIVE must be decided from
+    metadata that is identical on all processes (process_count, the
+    array's device_set vs the global device list). Deciding from
+    ``is_fully_addressable`` deadlocks: with n_nodes <= devices-per-
+    host the whole submesh lives on host 0, host 0 sees a fully-
+    addressable array and returns early, while every other host walks
+    into ``broadcast_one_to_all`` and blocks alone.
     """
-    if getattr(x, "is_fully_addressable", True):
-        return np.asarray(x)
+    if jax.process_count() == 1 or not hasattr(x, "sharding"):
+        return np.asarray(x)  # single process / plain host value
     from jax.experimental import multihost_utils
 
     submesh = len(x.sharding.device_set) < len(jax.devices())
     if not submesh:
+        # full mesh: every process owns shards, allgather serves all
         return np.asarray(multihost_utils.process_allgather(x, tiled=True))
-    if x.addressable_shards:
+    # submesh: shard owners resolve locally, everyone joins the
+    # broadcast (including owners — it is a global collective)
+    if x.is_fully_addressable:
+        local = np.asarray(x)
+    elif x.addressable_shards:
         if x.sharding.is_fully_replicated:
             local = np.asarray(x.addressable_shards[0].data)
         else:

@@ -566,8 +566,24 @@ class DashboardHandler(BaseHTTPRequestHandler):
                 and body and not looks_json else None
             )
             if parts == ["login"]:
+                # PRE-SESSION endpoint: no session cookie exists yet, so
+                # the derived per-session token cannot apply. Login CSRF
+                # ("log the victim into the attacker's account") is
+                # covered by the SameSite=Strict session cookie set in
+                # _login — a cross-site form never sends it, and this
+                # app has no pre-auth state worth riding. A double-
+                # submit pre-session token would only re-cover ancient
+                # non-SameSite clients; documented in docs/webapp.md.
                 return self._login(body, form)
             if parts == ["logout"]:
+                # logout is state-changing and cookie-authenticated:
+                # it requires the derived CSRF token like every other
+                # session POST (a cross-site form could otherwise kill
+                # the session — a nuisance-class but real CSRF). With
+                # no session there is nothing to forge: plain redirect.
+                if (self.sessions.get(self._session_token()) is not None
+                        and not self._csrf_ok(body, form)):
+                    return self._json_code({"error": "bad csrf token"}, 403)
                 return self._logout()
             if len(parts) == 3 and parts[:2] == ["api", "users"]:
                 if not self._admin_ok(form):
@@ -911,7 +927,8 @@ class DashboardHandler(BaseHTTPRequestHandler):
                 f"logged in as {html.escape(session['user'])} "
                 f"({html.escape(session['role'])}) "
                 "<form method='post' action='/logout' "
-                "style='display:inline;margin:0'><button>log out</button>"
+                "style='display:inline;margin:0'>"
+                f"{self._csrf_field()}<button>log out</button>"
                 "</form>"
                 + (" | <a href='/admin/users'>users</a>"
                    if session["role"] == "admin" else "")

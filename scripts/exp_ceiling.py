@@ -221,6 +221,87 @@ def main() -> None:
           bytes_moved=2 * (S * (3136 + 2048) + n * 3136 * 2048) * 2,
           eff=tile_eff(2048, 3136))
 
+    # ---- dense1 backward SPLIT (round 6): which half owes the 7.5 ms?
+    # The combined probe cannot say whether XLA's dgrad ([b,2048] @
+    # w^T, weight re-streamed) or wgrad (a^T @ cot, activation
+    # re-streamed) carries the overage — the fused Pallas kernel
+    # (ops.pallas_gemm.dense_bwd) only pays off if the split shows the
+    # re-streaming, not the MXU, is the cost. Diagnostic only: the
+    # split probes are excluded from the round-composition sum (the
+    # combined probe above stays the composition's line item).
+    def d1_dgrad(c):
+        a, w, cot = c
+        _, vjp = jax.vjp(lambda aa: jnp.einsum("nbk,nkh->nbh", aa, w), a)
+        return vjp(cot)[0] + a, w, cot
+
+    probe("dense1 dgrad only", d1_dgrad, (xd, wd, cotd),
+          flops=S * 3136 * 2048 * 2,
+          bytes_moved=(S * (2048 + 3136) + n * 3136 * 2048) * 2,
+          eff=tile_eff(2048, 3136))
+
+    def d1_wgrad(c):
+        a, w, cot = c
+        _, vjp = jax.vjp(lambda ww: jnp.einsum("nbk,nkh->nbh", a, ww), w)
+        return a, vjp(cot)[0] + w, cot
+
+    probe("dense1 wgrad only", d1_wgrad, (xd, wd, cotd),
+          flops=S * 3136 * 2048 * 2,
+          bytes_moved=(S * (3136 + 2048) + n * 3136 * 2048) * 2,
+          eff=tile_eff(3136, 2048))
+
+    # ---- Pallas kernel candidates at the same shapes (round 6) -------
+    # TPU-only: interpret mode is a correctness tool, these shapes
+    # would take minutes per probe on CPU. probe() already catches
+    # Mosaic lowering failures and prints FAILED instead of dying.
+    if jax.default_backend() == "tpu":
+        from p2pfl_tpu.ops import pallas_gemm
+
+        def c1_pallas_fwd(c):
+            x, w = c
+            p = patches(x).reshape(n, b * 784, 25)
+            out = jax.vmap(pallas_gemm.patches_matmul)(
+                p, w.reshape(n, 25, 32))
+            out = out.reshape(n, b, 28, 28, 32)
+            return out.mean(-1, keepdims=True) + x, w
+
+        probe("conv1 fwd pallas", c1_pallas_fwd, (x1, w1),
+              flops=S * 784 * 25 * 32 * 2,
+              bytes_moved=S * 784 * (1 + 25 + 32) * 2,
+              eff=tile_eff(25, 32))
+
+        def c1_pallas_wgrad(c):
+            x, w, cot = c
+
+            def f(ww):
+                p = patches(x).reshape(n, b * 784, 25)
+                out = jax.vmap(pallas_gemm.patches_matmul)(
+                    p, ww.reshape(n, 25, 32))
+                return out.reshape(n, b, 28, 28, 32)
+
+            _, vjp = jax.vjp(f, w)
+            dw = vjp(cot)[0]
+            return x, dw + w, cot + jnp.broadcast_to(
+                dw.sum((1, 2, 3))[:, None, None, None, :], cot.shape)
+
+        probe("conv1 wgrad pallas", c1_pallas_wgrad, (x1, w1, cot1),
+              flops=S * 784 * 25 * 32 * 2,
+              bytes_moved=S * 784 * (25 + 32) * 2,
+              eff=tile_eff(25, 32))
+
+        def d1_pallas_bwd(c):
+            a, w, cot = c
+            da, dw = jax.vmap(pallas_gemm.dense_bwd)(a, w, cot)
+            return da + a, dw.astype(w.dtype) + w, cot
+
+        probe("dense1 bwd pallas", d1_pallas_bwd, (xd, wd, cotd),
+              flops=2 * S * 3136 * 2048 * 2,
+              bytes_moved=(S * (3136 + 2048) + n * 3136 * 2048) * 2,
+              eff=tile_eff(2048, 3136))
+    else:
+        print("(pallas kernel probes skipped: backend is "
+              f"{jax.default_backend()}, kernels target TPU Mosaic)",
+              flush=True)
+
     def d2_fwd(c):
         return (jnp.einsum("nbk,nkh->nbh", c[0], c[1])
                 .mean(-1, keepdims=True) + c[0], c[1])
@@ -264,8 +345,11 @@ def main() -> None:
 
     # ---- summary ------------------------------------------------------
     print("\nround composition (2 steps/epoch at b336):")
-    per_step = [r for r in rows if r[0] not in
-                ("conv1 fwd packed4", "fedavg mix einsum")]
+    diagnostic = ("conv1 fwd packed4", "fedavg mix einsum",
+                  "dense1 dgrad only", "dense1 wgrad only",
+                  "conv1 fwd pallas", "conv1 wgrad pallas",
+                  "dense1 bwd pallas")
+    per_step = [r for r in rows if r[0] not in diagnostic]
     meas = sum(r[1] for r in per_step)
     floor = sum(r[4] for r in per_step)
     print(f"  per-step measured sum {meas:.1f} ms, achievable floor "

@@ -81,7 +81,7 @@ def main() -> None:
                 optimizer="adam", seed=4,
                 shared_aggregate=shared,
                 surrogate_profile=profile,
-                model_kwargs={"use_flash": False, "remat": True,
+                model_kwargs={"remat": True,
                               "scan_layers": True})
             try:
                 _, _, final, accs = bench._accuracy_run(

@@ -1,15 +1,17 @@
 """Round-4 bisection of the fused-trajectory TPU fault (VERDICT r3 #3).
 
 The failing shape (bench round 3): ViT round program AND its eval
-fused into ONE fori_loop dispatch, with {flash, remat, scan_layers}
-on, vmapped over nodes — intermittently faults the TPU worker; every
-piece is clean standalone (scripts/repro_vit_fault.py). This script
+fused into ONE fori_loop dispatch, with {remat, scan_layers} on (and,
+historically, the flash kernel — removed in round 6, docs/perf.md
+§5b; the fault reproduced with and without it), vmapped over nodes —
+intermittently faults the TPU worker; every piece is clean standalone
+(scripts/repro_vit_fault.py). This script
 builds exactly that fused shape, minimised, with every suspected
 ingredient toggleable, so single fresh-process runs can name the
 crashing combination:
 
     python scripts/repro_fused_fault.py \
-        --flash 1 --remat 1 --scan 1 --eval 1 \
+        --remat 1 --scan 1 --eval 1 \
         --layers 2 --nodes 32 --batch 64 --rounds 20 --trips 3
 
 Exit code 0 prints CLEAN; a worker fault kills the process (the
@@ -32,7 +34,7 @@ import optax
 
 def main() -> int:
     ap = argparse.ArgumentParser()
-    for flag, default in (("flash", 1), ("remat", 1), ("scan", 1),
+    for flag, default in (("remat", 1), ("scan", 1),
                           ("eval", 1), ("layers", 2), ("nodes", 32),
                           ("batch", 64), ("rounds", 20), ("trips", 3)):
         ap.add_argument(f"--{flag}", type=int, default=default)
@@ -40,8 +42,7 @@ def main() -> int:
 
     from p2pfl_tpu.models import get_model
 
-    model = get_model("vit-tiny", use_flash=bool(args.flash),
-                      remat=bool(args.remat),
+    model = get_model("vit-tiny", remat=bool(args.remat),
                       scan_layers=bool(args.scan),
                       depth=args.layers)
     n, bsz = args.nodes, args.batch
@@ -88,7 +89,7 @@ def main() -> int:
         s = float(jnp.sum(accs))
         print(f"trip {trip} ok sum={s:.3f} "
               f"({time.monotonic() - t0:.0f}s)", flush=True)
-    print(f"CLEAN flash={args.flash} remat={args.remat} scan={args.scan} "
+    print(f"CLEAN remat={args.remat} scan={args.scan} "
           f"eval={args.eval} layers={args.layers} nodes={args.nodes} "
           f"batch={args.batch} rounds={args.rounds}x{args.trips} "
           f"({time.monotonic() - t0:.0f}s)")

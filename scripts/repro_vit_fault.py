@@ -1,7 +1,9 @@
 """Bisect the intermittent TPU fault: ViT fwd+bwd in a loop, vmapped
-over 32 nodes, toggling {use_flash, remat, scan_layers}. Run each
-combo in a FRESH process: python scripts/repro_vit_fault.py F R S N
-(F/R/S in {0,1}, N iterations)."""
+over 32 nodes, toggling {remat, scan_layers}. (The use_flash toggle
+was retired with the flash kernel in round 6 — the fault reproduced
+with and without it, docs/perf.md §5b.) Run each combo in a FRESH
+process: python scripts/repro_vit_fault.py R S N (R/S in {0,1},
+N iterations)."""
 
 from __future__ import annotations
 
@@ -16,12 +18,10 @@ import jax.numpy as jnp
 import optax
 
 
-def main(use_flash: bool, remat: bool, scan_layers: bool,
-         iters: int = 150) -> None:
+def main(remat: bool, scan_layers: bool, iters: int = 150) -> None:
     from p2pfl_tpu.models import get_model
 
-    model = get_model("vit-tiny", use_flash=use_flash, remat=remat,
-                      scan_layers=scan_layers)
+    model = get_model("vit-tiny", remat=remat, scan_layers=scan_layers)
     n, bsz = 32, 115
     key = jax.random.PRNGKey(0)
     x1 = jnp.zeros((1, 32, 32, 3), jnp.float32)
@@ -50,14 +50,14 @@ def main(use_flash: bool, remat: bool, scan_layers: bool,
         del junk
         if i % 20 == 0:
             print(f"iter {i} ok ({time.monotonic()-t0:.0f}s)", flush=True)
-    print(f"CLEAN {iters} iters flash={use_flash} remat={remat} "
+    print(f"CLEAN {iters} iters remat={remat} "
           f"scan={scan_layers} ({time.monotonic()-t0:.0f}s)")
 
 
 if __name__ == "__main__":
-    if len(sys.argv) < 4:
-        sys.exit("usage: repro_vit_fault.py F R S [iters]  "
-                 "(use_flash remat scan_layers, each 0/1)")
-    f, r, s = (bool(int(a)) for a in sys.argv[1:4])
-    n = int(sys.argv[4]) if len(sys.argv) > 4 else 150
-    main(f, r, s, n)
+    if len(sys.argv) < 3:
+        sys.exit("usage: repro_vit_fault.py R S [iters]  "
+                 "(remat scan_layers, each 0/1)")
+    r, s = (bool(int(a)) for a in sys.argv[1:3])
+    n = int(sys.argv[3]) if len(sys.argv) > 3 else 150
+    main(r, s, n)

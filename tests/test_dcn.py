@@ -248,3 +248,48 @@ def test_four_process_dcn_scenario_unaligned(tmp_path):
         for p in (tmp_path / "ckpt").glob("round_*.ckpt.msgpack")
     )
     assert rounds == [1, 2, 3, 4], rounds  # resumed past round 2
+
+
+def test_fetch_global_branch_decided_from_process_identical_metadata(
+        monkeypatch):
+    """Regression (ADVICE r5 medium): with n_nodes <= devices-per-host
+    the whole submesh lives on host 0, which sees a FULLY-ADDRESSABLE
+    array. Deciding the early return from ``is_fully_addressable``
+    (true only on host 0) made host 0 skip ``broadcast_one_to_all``
+    while every other host entered it and blocked alone — a deadlock.
+    The collective-entering branch must follow only process-identical
+    metadata (process_count, device_set vs the global device list), so
+    a shard-owning process still JOINS the broadcast.
+
+    Single-process by construction: jax.process_count is stubbed to 2
+    and the broadcast recorded, so the branch logic is pinned without
+    a jax.distributed job."""
+    import jax
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    from p2pfl_tpu.parallel import mesh as mesh_mod
+
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    calls = []
+    monkeypatch.setattr(
+        multihost_utils, "broadcast_one_to_all",
+        lambda v: (calls.append("broadcast"), v)[1])
+
+    # a 1-device submesh of the 8-device CI mesh: device_set is a
+    # strict subset of jax.devices(), yet the array is fully
+    # addressable here — exactly host 0's view of the trap shape
+    m = mesh_mod.federation_mesh(n_devices=1)
+    x = jax.device_put(np.arange(8.0), mesh_mod.stacked_sharding(m))
+    assert x.is_fully_addressable
+    assert len(x.sharding.device_set) < len(jax.devices())
+
+    out = mesh_mod.fetch_global(x)
+    assert calls == ["broadcast"]  # host 0 joined the collective
+    np.testing.assert_array_equal(out, np.arange(8.0))
+
+    # single process: no collectives at all, plain host copy
+    monkeypatch.setattr(jax, "process_count", lambda: 1)
+    calls.clear()
+    np.testing.assert_array_equal(mesh_mod.fetch_global(x), np.arange(8.0))
+    assert calls == []

@@ -139,11 +139,38 @@ def test_login_gates_writes(auth_server):
     assert "logged in as root" in page and "admin" in page
     _, page = b.get("/admin/users")
     assert b.csrf() in page
-    # logout drops the session
-    code, _ = b.post("/logout")
+    # logout drops the session (with the token — it is a session POST)
+    code, _ = b.post("/logout", csrf=True)
     assert code == 200
     code, _ = b.post("/api/scenario/x/stop", {"csrf": "x"})
     assert code == 401
+
+
+def test_logout_requires_csrf(auth_server):
+    """Round-6: logout is state-changing and cookie-authenticated, so
+    it needs the derived CSRF token like every other session POST — a
+    cross-site form must not be able to kill the session."""
+    b = _Browser(auth_server)
+    b.post("/login", {"user": "root", "password": "rootpw"})
+    # forged logout (no token / wrong token): refused, session survives
+    code, _ = b.post("/logout")
+    assert code == 403
+    code, _ = b.post("/logout", {"csrf": "wrong"})
+    assert code == 403
+    code, _ = b.post("/api/scenario/x/stop", csrf=True)
+    assert code == 200
+    # the served form's token: logout succeeds, session dropped
+    code, _ = b.post("/logout", csrf=True)
+    assert code == 200
+    code, _ = b.post("/api/scenario/x/stop", {"csrf": "x"})
+    assert code == 401
+    # with no session there is nothing to forge: plain redirect, no 403
+    code, _ = b.post("/logout")
+    assert code == 200
+    # the dashboard's logout form embeds the token
+    b.post("/login", {"user": "root", "password": "rootpw"})
+    _, page = b.get("/")
+    assert f"value='{b.csrf()}'" in page and "action='/logout'" in page
 
 
 def test_role_gating_on_user_crud(auth_server):

@@ -593,6 +593,108 @@ def _vit32_inprocess() -> None:
     out["vit32_synthetic_data"] = run["ds"].synthetic
     emit()
 
+    # round-time attribution (VERDICT r5 #7): one scan-slope pass
+    # splitting the Krum round into its candidate sinks.
+    #   layer-scan: round time at depth 12 vs 6 under identical flags;
+    #     slope × 12 = the transformer stack's share (fwd+bwd through
+    #     the scanned blocks), the intercept is everything else;
+    #   remat recompute: depth-12 round with remat OFF; the delta is
+    #     the recompute that checkpointing trades for activation HBM;
+    #   Krum Gram / aggregate: the aggregation program in isolation on
+    #     a [32, params] stack — the pairwise-distance Gram matmul
+    #     timed separately from full Krum (selection + weighted mean).
+    # Emitted progressively; sub-builds share the persistent compile
+    # cache, and a failure here must not cost the trajectory below.
+    t_full = out[f"{prefix}_round_s"]
+    try:
+        import gc
+
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        def rebuild(**over):
+            kw = dict(remat=True, scan_layers=True)
+            kw.update(over)
+            return _build(32, dataset="cifar10", model="vit-tiny",
+                          topology="fully", aggregator=Krum(f=1, m=3),
+                          partition="iid", samples_per_node=512,
+                          batch_size=115, learning_rate=1e-3,
+                          optimizer="adam", seed=4,
+                          surrogate_profile="easy",
+                          shared_aggregate=True, model_kwargs=kw)
+
+        run.clear()
+        jax.clear_caches()
+        gc.collect()
+        t_d6 = _time_chained(rebuild(depth=6), k=5, reps=2)
+        slope = (t_full - t_d6) / 6.0
+        out["vit32_attr_layer_scan_s"] = round(max(slope, 0.0) * 12, 4)
+        emit()
+        jax.clear_caches()
+        gc.collect()
+        t_noremat = _time_chained(rebuild(remat=False), k=5, reps=2)
+        out["vit32_attr_remat_recompute_s"] = round(
+            max(t_full - t_noremat, 0.0), 4)
+        emit()
+        jax.clear_caches()
+        gc.collect()
+
+        from p2pfl_tpu.models import get_model
+
+        model = get_model("vit-tiny", remat=True, scan_layers=True)
+        p0 = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 32, 32, 3), jnp.float32))
+        stacked = jax.tree.map(lambda x: jnp.stack([x] * 32), p0)
+        wts = jnp.ones((32,), jnp.float32)
+
+        def timeit(fn, *a):
+            jax.block_until_ready(fn(*a))  # compile
+            ts = []
+            for _ in range(3):
+                t0 = time.monotonic()
+                jax.block_until_ready(fn(*a))
+                ts.append(time.monotonic() - t0)
+            return float(np.median(ts))
+
+        t_krum = timeit(jax.jit(lambda s, w: Krum(f=1, m=3)(s, w)),
+                        stacked, wts)
+
+        def gram_only(s, w):
+            n = w.shape[0]
+            flat = jnp.concatenate(
+                [x.reshape(n, -1).astype(jnp.float32)
+                 for x in jax.tree.leaves(s)], axis=1)
+            sq = jnp.sum(flat * flat, axis=1)
+            return sq[:, None] + sq[None, :] - 2.0 * (flat @ flat.T)
+
+        t_gram = timeit(jax.jit(gram_only), stacked, wts)
+        out["vit32_attr_krum_gram_s"] = round(t_gram, 4)
+        out["vit32_attr_aggregate_s"] = round(max(t_krum - t_gram, 0.0), 4)
+        out["vit32_attr_other_s"] = round(
+            max(t_full - out["vit32_attr_layer_scan_s"]
+                - out["vit32_attr_remat_recompute_s"] - t_krum, 0.0), 4)
+        del stacked, p0
+        emit()
+    except Exception as e:
+        print(f"vit32 attribution failed: {e!r}"[:300], file=sys.stderr,
+              flush=True)
+    finally:
+        # the trajectory below needs a live run dict; rebuilding is
+        # cheap (no eager compile — jit caches fill on first call, and
+        # the round program itself is in the persistent cache)
+        import jax
+
+        jax.clear_caches()
+        run = _build(32, dataset="cifar10", model="vit-tiny",
+                     topology="fully", aggregator=Krum(f=1, m=3),
+                     partition="iid", samples_per_node=512,
+                     batch_size=115, learning_rate=1e-3,
+                     optimizer="adam", seed=4,
+                     surrogate_profile="easy",
+                     shared_aggregate=True,
+                     model_kwargs={"remat": True, "scan_layers": True})
+
     fused_ok = True
     try:
         _, _, final, accs = _accuracy_run(run, target=0.80, max_rounds=20,
@@ -673,8 +775,11 @@ def _socket24() -> dict:
     """VERDICT r2 #6 metric: steady-state round time of a 24-node
     SOCKET federation (fully connected, gossip fan-out 12 — raised
     from 6 in round 5 after relay damping made wide PARAMS fan-out
-    cheap, docs/perf.md §8 — binding train-set cap 8) in the
-    in-process simulation mode.
+    cheap, docs/perf.md §8) in the in-process simulation mode, in BOTH
+    train-set configs: the capped headline (train_set_size=8, the
+    r2-r6 continuity key) and the uncapped payload-bound round
+    (train_set_size=24 — every node trains and gossips, the config the
+    round-7 data-plane A/B targets, docs/perf.md §7).
     Runs on the CPU backend in a subprocess — 24 asyncio nodes cannot
     share the bench chip, and the socket path's cost is control-plane,
     not compute."""
@@ -693,37 +798,111 @@ import sys; sys.path.insert(0, %r)
 from p2pfl_tpu.config.schema import (ScenarioConfig, TrainingConfig,
     ProtocolConfig, DataConfig)
 from p2pfl_tpu.p2p.launch import run_simulation
-cfg = ScenarioConfig(
-    name="sock24", n_nodes=24, topology="fully",
-    data=DataConfig(dataset="mnist", samples_per_node=60),
-    training=TrainingConfig(rounds=3, epochs_per_round=1,
-                            learning_rate=0.05),
-    protocol=ProtocolConfig(heartbeat_period_s=0.5,
-                            aggregation_timeout_s=60.0,
-                            vote_timeout_s=10.0, train_set_size=8,
-                            # fanout 12: with periodic-flood relays
-                            # damped on the declared full mesh, a wider
-                            # fan-out only touches PARAMS gossip and
-                            # one-shot floods — measured 2.9 -> 2.5
-                            # s/round (docs/perf.md §7 sweep)
-                            gossip_fanout=12),
-)
-print("BENCH_SOCK24 " + json.dumps(run_simulation(cfg, timeout=280)))
+
+def cfg(ts):
+    return ScenarioConfig(
+        name="sock24", n_nodes=24, topology="fully",
+        data=DataConfig(dataset="mnist", samples_per_node=60),
+        training=TrainingConfig(rounds=3, epochs_per_round=1,
+                                learning_rate=0.05),
+        protocol=ProtocolConfig(heartbeat_period_s=0.5,
+                                aggregation_timeout_s=60.0,
+                                vote_timeout_s=10.0, train_set_size=ts,
+                                # fanout 12: with periodic-flood relays
+                                # damped on the declared full mesh, a
+                                # wider fan-out only touches PARAMS
+                                # gossip and one-shot floods — measured
+                                # 2.9 -> 2.5 s/round (perf.md §7 sweep)
+                                gossip_fanout=12),
+    )
+# capped first: the continuity key must survive a mid-phase kill
+print("BENCH_SOCK24 " + json.dumps(run_simulation(cfg(8), timeout=280)),
+      flush=True)
+print("BENCH_SOCK24U " + json.dumps(run_simulation(cfg(24), timeout=280)),
+      flush=True)
 """ % (str(__import__("pathlib").Path(__file__).resolve().parent),)
+    out: dict = {"socket_round_s_24node": None}
     try:
         res = subprocess.run([sys.executable, "-c", code],
                              capture_output=True, text=True, timeout=500)
         for line in res.stdout.splitlines():
             if line.startswith("BENCH_SOCK24 "):
                 got = _json.loads(line[len("BENCH_SOCK24 "):])
-                return {"socket_round_s_24node": got.get("round_s"),
-                        "socket_24node_rounds": got.get("rounds")}
-        print(f"socket24 child rc={res.returncode}: {res.stderr[-400:]}",
-              file=sys.stderr)
+                out["socket_round_s_24node"] = got.get("round_s")
+                out["socket_24node_rounds"] = got.get("rounds")
+            elif line.startswith("BENCH_SOCK24U "):
+                got = _json.loads(line[len("BENCH_SOCK24U "):])
+                out["socket_round_s_24node_uncapped"] = got.get("round_s")
+        if out["socket_round_s_24node"] is None:
+            print(f"socket24 child rc={res.returncode}: "
+                  f"{res.stderr[-400:]}", file=sys.stderr)
     except Exception as e:
-        import sys
         print(f"socket24 failed: {e!r}", file=sys.stderr)
-    return {"socket_round_s_24node": None}
+    return out
+
+
+def _socket_mp(n_nodes: int = 24, rounds: int = 3,
+               layout_ks: tuple = (1, 4)) -> dict:
+    """Tentpole (b), round 7: the EXACT 24-node capped bench scenario
+    run through ``p2p.launch`` across real OS processes, in two
+    layouts — 24×1 (one node per process) and 6×4 (four nodes per
+    child event loop) — versus the in-process simulation-mode key
+    above. Per-layout round time = the slowest node's post-warm-up
+    round-loop wall clock (``learn_wall_s``, p2p/launch.py:_run_node)
+    over the round count, so process startup / dataset build / XLA
+    compile are excluded exactly as simulation mode excludes them.
+
+    Each child pins the CPU backend (N processes cannot share one
+    chip); unlike simulation mode there is no SharedTrainer, so every
+    process compiles and trains its own learner — the GIL-sharing the
+    §7 claim says simulation mode pays is gone, at the price of real
+    kernel TCP between processes."""
+    import tempfile
+
+    from p2pfl_tpu.config.schema import (
+        DataConfig,
+        ProtocolConfig,
+        ScenarioConfig,
+        TrainingConfig,
+    )
+    from p2pfl_tpu.p2p.launch import launch
+
+    cfg = ScenarioConfig(
+        name="sock24mp", n_nodes=n_nodes, topology="fully",
+        data=DataConfig(dataset="mnist", samples_per_node=60),
+        training=TrainingConfig(rounds=rounds, epochs_per_round=1,
+                                learning_rate=0.05),
+        protocol=ProtocolConfig(heartbeat_period_s=0.5,
+                                aggregation_timeout_s=60.0,
+                                vote_timeout_s=10.0,
+                                train_set_size=min(8, n_nodes),
+                                gossip_fanout=12),
+    )
+    mp: dict = {}
+    with tempfile.TemporaryDirectory() as td:
+        path = pathlib.Path(td) / "sock24mp.json"
+        cfg.save(path)
+        for k in layout_ks:
+            label = f"{-(-n_nodes // k)}x{k}"
+            try:
+                results = launch(cfg, path, platform="cpu",
+                                 nodes_per_proc=k)
+                walls = [r["learn_wall_s"] for r in results
+                         if r.get("learn_wall_s")]
+                done = [r for r in results
+                        if r.get("round") == rounds]
+                if walls and len(done) == cfg.n_nodes:
+                    mp[label] = round(max(walls) / rounds, 3)
+                else:
+                    print(f"socket_mp {label}: {len(done)}/{cfg.n_nodes}"
+                          f" nodes finished, {len(walls)} walls",
+                          file=sys.stderr)
+                    mp[label] = None
+            except Exception as e:
+                print(f"socket_mp {label} failed: {e!r}"[:300],
+                      file=sys.stderr)
+                mp[label] = None
+    return {"socket_round_s_24node_multiproc": mp}
 
 
 # --------------------------------------------------------------------
@@ -840,6 +1019,28 @@ def _phase_headline() -> None:
         print(f"8-node continuity failed: {e!r}"[:300], file=sys.stderr,
               flush=True)
 
+    # north-star non-IID sibling (VERDICT r5 #1): the SAME headline
+    # config over the hard surrogate's writer ids — whole writers per
+    # node (LEAF semantics, datasets/partition.py:writer_partition), so
+    # each node inherits writer style + class skew instead of an IID
+    # slice. Reported beside the IID keys; perf.md §6.4 discusses the
+    # IID↔writer delta.
+    try:
+        run8.clear()
+        jax.clear_caches()
+        run_w = _build(64, momentum_dtype="bf16", partition="writer",
+                       model_kwargs={"param_dtype": jnp.bfloat16})
+        part_w = {"writer_round_s": round(_time_chained(run_w), 4)}
+        _part(part_w)
+        r80w, _, final_w, _ = _accuracy_run(run_w, measure_seconds=False)
+        _part({
+            "writer_rounds_to_80pct": r80w,
+            "writer_final_accuracy": round(final_w, 4),
+        })
+    except Exception as e:
+        print(f"writer-partition headline failed: {e!r}"[:300],
+              file=sys.stderr, flush=True)
+
 
 def _phase_cifar16() -> None:
     _part(_cifar16())
@@ -851,6 +1052,10 @@ def _phase_cpu8() -> None:
 
 def _phase_socket24() -> None:
     _part(_socket24())
+
+
+def _phase_socket_mp() -> None:
+    _part(_socket_mp())
 
 
 def _phase_vit32() -> None:
@@ -996,6 +1201,7 @@ def main() -> None:
         ("cifar16", "_phase_cifar16", 120),
         ("cpu8", "_phase_cpu8", 45),
         ("socket24", "_phase_socket24", 45),
+        ("socket_mp", "_phase_socket_mp", 150),
         ("vit32", "_phase_vit32", 120),
     ]
     for name, fn, min_s in phases:

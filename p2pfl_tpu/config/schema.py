@@ -108,6 +108,13 @@ class ProtocolConfig:
     # at-most-once). <=0 floods to every peer (small-federation default;
     # the origin's own broadcast always goes to all its peers).
     gossip_fanout: int = 0
+    # per-peer egress lane depth (frames, not bytes): each connection
+    # owns a bounded send queue drained by its own task, so broadcast
+    # enqueues concurrently and only a FULL lane (that one peer not
+    # reading) backpressures the producer. Deep enough that a round's
+    # control traffic never blocks; shallow enough that a wedged peer
+    # holds O(depth) frames, not the process's memory.
+    send_queue_depth: int = 64
 
 
 @dataclasses.dataclass

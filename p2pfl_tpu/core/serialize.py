@@ -62,17 +62,25 @@ def encode_parameters(params: Any, contributors: tuple[int, ...] = (), weight: i
 
 
 def decode_parameters(blob: bytes) -> ParamsPayload:
-    """Decode a payload. Raises DecodingParamsError on any malformation."""
+    """Decode a payload. Raises DecodingParamsError on any malformation.
+
+    Accepts any bytes-like object and never copies the blob: the CRC,
+    the contributor table, and the msgpack body are all read through
+    ``memoryview`` slices of the buffer the socket read produced
+    (``blob[off:]`` on a tens-of-MB bytes object was a second full
+    host-side copy per receive before round 7).
+    """
     try:
-        magic, version, n_contrib, crc = _HEADER.unpack_from(blob, 0)
+        mv = memoryview(blob)
+        magic, version, n_contrib, crc = _HEADER.unpack_from(mv, 0)
         if magic != _MAGIC or version != _VERSION:
             raise ValueError(f"bad magic/version {magic!r}/{version}")
-        if zlib.crc32(blob[_HEADER.size :]) != crc:
+        if zlib.crc32(mv[_HEADER.size :]) != crc:
             raise ValueError("payload CRC mismatch (corrupt or tampered)")
         off = _HEADER.size
-        contributors = struct.unpack_from(f">{n_contrib}I", blob, off)
+        contributors = struct.unpack_from(f">{n_contrib}I", mv, off)
         off += 4 * n_contrib
-        obj = flax_ser.msgpack_restore(blob[off:])
+        obj = flax_ser.msgpack_restore(mv[off:])
         return ParamsPayload(
             params=obj["p"], contributors=tuple(contributors), weight=int(obj["w"])
         )

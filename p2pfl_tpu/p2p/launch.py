@@ -145,6 +145,11 @@ async def _run_node(cfg: ScenarioConfig, idx: int, ports: list[int],
                 await asyncio.sleep(cfg.protocol.heartbeat_period_s)
 
         status_task = asyncio.get_event_loop().create_task(_publish_loop())
+    # warm the compiled programs off-loop BEFORE the round clock can
+    # start (run_simulation warms every node the same way): the first
+    # fit would otherwise bill its XLA compile to round 1 and skew
+    # learn_wall_s, the number the multi-process bench reports
+    await asyncio.get_running_loop().run_in_executor(None, learner.warm_up)
     if cfg.nodes[idx].start:
         learner.init()
         node.set_start_learning(cfg.training.rounds,
@@ -165,13 +170,24 @@ async def _run_node(cfg: ScenarioConfig, idx: int, ports: list[int],
              "peers": len(node.peers), "leader": node.leader, **metrics},
         )
     await node.stop()
-    return {"node": idx, "round": node.round, **metrics}
+    result = {"node": idx, "round": node.round, **metrics}
+    # round-loop wall clock (post-warm-up, excludes startup/diffusion):
+    # what socket_round_s_24node_multiproc is computed from
+    if node.learn_t0 is not None and node.learn_t1 is not None:
+        result["learn_wall_s"] = round(node.learn_t1 - node.learn_t0, 3)
+    return result
 
 
-def node_main(config_path: str, idx: int, ports: list[int],
+def node_main(config_path: str, idx: int | list[int], ports: list[int],
               tls_dir: str | None = None,
               hosts: list[str] | None = None,
               bind: str = "127.0.0.1") -> None:
+    """Child-process entry. ``idx`` may be a LIST of node indices: all
+    of them share this process's event loop (the k-nodes-per-process
+    layouts the multi-process bench measures, e.g. 6 processes × 4
+    nodes) — in-between the two extremes of run_simulation (n×1-loop)
+    and one-process-per-node."""
+    idxs = [idx] if isinstance(idx, int) else list(idx)
     cfg = ScenarioConfig.load(config_path)
     if cfg.log_dir:
         # per-participant log trail + environment banner
@@ -179,11 +195,19 @@ def node_main(config_path: str, idx: int, ports: list[int],
         from p2pfl_tpu.utils.env import log_environment
         from p2pfl_tpu.utils.nodelog import setup_node_logging
 
-        setup_node_logging(cfg.log_dir, cfg.name, idx)
+        setup_node_logging(cfg.log_dir, cfg.name, idxs[0])
         log_environment()
-    result = asyncio.run(_run_node(cfg, idx, ports, tls_dir=tls_dir,
-                                   hosts=hosts, bind=bind))
-    print("P2PFL_RESULT " + json.dumps(result), flush=True)
+
+    async def _run_all() -> list[dict]:
+        return list(
+            await asyncio.gather(
+                *(_run_node(cfg, i, ports, tls_dir=tls_dir,
+                            hosts=hosts, bind=bind) for i in idxs)
+            )
+        )
+
+    for result in asyncio.run(_run_all()):
+        print("P2PFL_RESULT " + json.dumps(result), flush=True)
 
 
 async def _simulate(cfg: ScenarioConfig, timeout: float = 600) -> dict:
@@ -275,8 +299,14 @@ def run_simulation(cfg: ScenarioConfig, timeout: float = 600) -> dict:
 
 
 def launch(cfg: ScenarioConfig, config_path: str | pathlib.Path,
-           platform: str | None = None) -> list[dict]:
-    """Spawn one OS process per node; collect their results.
+           platform: str | None = None,
+           nodes_per_proc: int = 1) -> list[dict]:
+    """Spawn node processes; collect their results.
+
+    ``nodes_per_proc`` > 1 packs k nodes into each child's event loop
+    (``--node "0,1,2,3"``), so a 24-node federation can run as 24×1,
+    6×4, … — the layouts the multi-process bench compares against the
+    all-in-one-loop simulation mode.
 
     ``platform="cpu"`` forces the children onto the CPU backend — N
     processes cannot share one TPU chip, so multi-process mode on a
@@ -295,10 +325,13 @@ def launch(cfg: ScenarioConfig, config_path: str | pathlib.Path,
 
         tls_dir = str(pathlib.Path(config_path).resolve().parent / "tls")
         make_scenario_credentials(tls_dir, cfg.n_nodes, name=cfg.name)
+    k = max(int(nodes_per_proc), 1)
+    groups = [list(range(i, min(i + k, cfg.n_nodes)))
+              for i in range(0, cfg.n_nodes, k)]
     procs = []
-    for i in range(cfg.n_nodes):
+    for group in groups:
         cmd = [sys.executable, "-m", "p2pfl_tpu.p2p.launch",
-               str(config_path), "--node", str(i),
+               str(config_path), "--node", ",".join(map(str, group)),
                "--ports", ",".join(map(str, ports))]
         if platform:
             cmd += ["--platform", platform]
@@ -320,8 +353,12 @@ def launch(cfg: ScenarioConfig, config_path: str | pathlib.Path,
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(prog="p2pfl_tpu.p2p.launch")
     ap.add_argument("config")
-    ap.add_argument("--node", type=int, default=None,
-                    help="run a single node in-process (child mode)")
+    ap.add_argument("--node", default=None,
+                    help="node index, or comma-separated indices to run "
+                         "on one event loop (child mode)")
+    ap.add_argument("--nodes-per-proc", type=int, default=1,
+                    help="parent mode: pack k nodes into each child "
+                         "process (e.g. 24 nodes, k=4 -> 6 processes)")
     ap.add_argument("--ports", default=None,
                     help="comma-separated port per node (child mode)")
     ap.add_argument("--platform", default=None,
@@ -339,14 +376,16 @@ def main(argv: list[str] | None = None) -> int:
 
         jax.config.update("jax_platforms", args.platform)
     if args.node is not None:
-        node_main(args.config, args.node,
+        node_main(args.config,
+                  [int(i) for i in str(args.node).split(",")],
                   [int(p) for p in args.ports.split(",")],
                   tls_dir=args.tls_dir,
                   hosts=args.hosts.split(",") if args.hosts else None,
                   bind=args.bind)
         return 0
     cfg = ScenarioConfig.load(args.config)
-    results = launch(cfg, args.config, platform=args.platform)
+    results = launch(cfg, args.config, platform=args.platform,
+                     nodes_per_proc=args.nodes_per_proc)
     print(json.dumps({"nodes": results}))
     return 0
 

@@ -50,14 +50,34 @@ from p2pfl_tpu.p2p.protocol import (
 
 log = logging.getLogger("p2pfl_tpu.p2p")
 
+#: transport-buffer ceiling for the idle-lane fast write (matches
+#: asyncio's default 64 KiB high-water mark): under it a send goes
+#: straight to the transport; over it the frame takes the bounded
+#: queue and the drain task's drain() await applies real backpressure
+_FAST_LANE_MAX = 1 << 16
+
 
 @dataclasses.dataclass
 class PeerState:
-    """One live connection (node_connection.py's socket half)."""
+    """One live connection (node_connection.py's socket half).
+
+    ``send_q`` + ``send_task`` form the connection's egress lane: every
+    outbound frame is enqueued and a single per-peer drain task owns
+    the writer. The queue is bounded (ProtocolConfig.send_queue_depth),
+    so a peer that stops reading exerts backpressure on ITS lane only —
+    broadcast enqueues to all lanes concurrently and never serializes
+    on the slowest peer's TCP buffer. The single-writer discipline also
+    guarantees frames never interleave and per-peer FIFO order holds
+    (round-state messages rely on stream order, see _train_round)."""
 
     idx: int
     writer: asyncio.StreamWriter
     reader_task: asyncio.Task | None = None
+    send_q: asyncio.Queue | None = None
+    send_task: asyncio.Task | None = None
+    # True only while the drain task is mid-write: the idle-lane fast
+    # path (node._write) must not interleave with it
+    draining: bool = False
 
 
 @dataclasses.dataclass
@@ -181,6 +201,12 @@ class P2PNode:
         # BEAT handler)
         self._beat_seen: dict[int, int] = {}
         self._round_active = False
+        # round-loop wall clock (set by _learning_loop): launch.py's
+        # multi-process bench reads these to time ROUNDS, excluding
+        # startup/compile/diffusion — comparable to run_simulation's
+        # post-warm-up clock
+        self.learn_t0: float | None = None
+        self.learn_t1: float | None = None
         self._server: asyncio.Server | None = None
         self._tasks: list[asyncio.Task] = []
         self._learn_task: asyncio.Task | None = None
@@ -208,10 +234,14 @@ class P2PNode:
         self.dedup.check_and_add(stop_msg.msg_id)
 
         async def announce(peer: PeerState) -> None:
+            # routed through the peer's send lane (never a concurrent
+            # direct write — that could interleave mid-frame with the
+            # drain task); flush waits on the queue, bounded per peer
             with contextlib.suppress(Exception):
-                await asyncio.wait_for(
-                    write_message(peer.writer, stop_msg), timeout=1.0
-                )
+                await asyncio.wait_for(self._write(peer, stop_msg),
+                                       timeout=1.0)
+                if peer.send_q is not None:
+                    await asyncio.wait_for(peer.send_q.join(), timeout=1.0)
 
         await asyncio.gather(
             *(announce(p) for p in list(self.peers.values()))
@@ -224,6 +254,10 @@ class P2PNode:
         if self.shaper is not None:
             self.shaper.close()  # in-flight shaped messages are lost
         for peer in list(self.peers.values()):
+            if peer.send_task:
+                peer.send_task.cancel()
+                with contextlib.suppress(asyncio.CancelledError):
+                    await peer.send_task
             if peer.reader_task:
                 peer.reader_task.cancel()
                 with contextlib.suppress(asyncio.CancelledError):
@@ -302,6 +336,14 @@ class P2PNode:
 
     def _register_peer(self, idx: int, reader, writer) -> PeerState:
         peer = PeerState(idx=idx, writer=writer)
+        if self.shaper is None:
+            # egress lane: bounded queue + one drain task per peer (the
+            # shaped path has its own per-link queues in netem.py, so
+            # only one writer owner ever exists per connection)
+            peer.send_q = asyncio.Queue(
+                maxsize=max(self.protocol.send_queue_depth, 1)
+            )
+            peer.send_task = asyncio.create_task(self._drain_send_q(peer))
         peer.reader_task = asyncio.create_task(self._read_loop(peer, reader))
         self.peers[idx] = peer
         self.membership.beat(idx)
@@ -363,6 +405,43 @@ class P2PNode:
         the old connection's dying task."""
         if self.peers.get(peer.idx) is peer:
             self.peers.pop(peer.idx, None)
+        if peer.send_task is not None and not peer.send_task.done():
+            peer.send_task.cancel()
+        if peer.send_q is not None:
+            # discard queued frames and wake any producer blocked on a
+            # full queue — the lane is dead, nothing will drain it
+            while True:
+                try:
+                    peer.send_q.get_nowait()
+                except asyncio.QueueEmpty:
+                    break
+                with contextlib.suppress(ValueError):
+                    peer.send_q.task_done()
+
+    async def _drain_send_q(self, peer: PeerState) -> None:
+        """Backpressure writer for one connection: drains the peer's
+        bounded send queue in FIFO order. The queue only sees traffic
+        when the lane is congested (see _write's idle-lane fast path),
+        so this task is parked on get() in the steady state. A write
+        failure drops the connection; the task then keeps consuming
+        (discarding) so producers blocked on put() unwedge until
+        stop()/drop cancels it."""
+        dead = False
+        while True:
+            msg = await peer.send_q.get()
+            try:
+                if not dead:
+                    peer.draining = True
+                    try:
+                        await write_message(peer.writer, msg)
+                    except (ConnectionError, RuntimeError, OSError):
+                        dead = True
+                        self._drop_conn(peer)
+                    finally:
+                        peer.draining = False
+            finally:
+                with contextlib.suppress(ValueError):
+                    peer.send_q.task_done()
 
     async def _read_loop(self, peer: PeerState, reader) -> None:
         try:
@@ -451,6 +530,9 @@ class P2PNode:
             self.peer_roles.pop(gone_id, None)
             conn = self.peers.pop(gone_id, None)
             if conn is not None:
+                # full lane teardown (send task included — an orphaned
+                # drain task parked on get() would outlive the run)
+                self._drop_conn(conn)
                 if conn.reader_task:
                     conn.reader_task.cancel()
                 conn.writer.close()
@@ -553,7 +635,7 @@ class P2PNode:
         if self._signer is not None and not msg.sig:
             msg.sig = self._signer.sign(msg.signing_bytes())
             msg.cert = self._signer.cert_pem
-            msg._wire = None  # signature changes the frame memo
+            msg._head = None  # signature changes the framed-header memo
         return msg
 
     def _verify_origin(self, msg: Message) -> bool:
@@ -577,15 +659,47 @@ class P2PNode:
             self.dedup.check_and_add(msg.msg_id)
         await self._forward(msg, exclude)
 
+    def _try_fast_write(self, peer: PeerState, msg: Message) -> bool:
+        """Idle-lane fast path: when nothing is queued, the drain task
+        is parked, and the transport buffer is under the high-water
+        mark, write synchronously — no queue hop, no task wakeup, not
+        even a drain() await (flow control is the buffer check itself;
+        measured: routing EVERY frame through the queue cost ~17% on
+        the 24-node control-bound round and ~38% on the payload-bound
+        one). The checks and the write run without an await between
+        them, so the sole-writer-per-connection invariant holds.
+        Returns True when the frame was handled (written or the
+        connection dropped), False when the caller must queue."""
+        q = peer.send_q
+        if (q is None or not q.empty() or peer.draining
+                or self.peers.get(peer.idx) is not peer):
+            return False
+        tr = peer.writer.transport
+        if tr.is_closing() or tr.get_write_buffer_size() >= _FAST_LANE_MAX:
+            return False
+        try:
+            peer.writer.writelines(msg.wire_segments())
+        except (ConnectionError, RuntimeError, OSError):
+            self._drop_conn(peer)
+        return True
+
     async def _write(self, peer: PeerState, msg: Message) -> None:
-        """Single egress point: a direct socket write, or the link
+        """Single egress point: the idle-lane fast write when the
+        peer's lane is clear, else enqueue onto its bounded send lane
+        (the drain task owns the socket under congestion), or the link
         shaper's delayed/lossy schedule when network emulation is on.
-        Shaped sends never raise here — delivery errors surface on the
-        link worker, which drops the connection."""
-        if self.shaper is None:
-            await write_message(peer.writer, msg)
-        else:
+        Blocks only when THIS peer's bounded queue is full
+        (backpressure); never raises for delivery errors — those
+        surface on the drain/link worker, which drops the connection."""
+        if self.shaper is not None:
             await self.shaper.send(peer, msg)
+        elif self._try_fast_write(peer, msg):
+            return
+        elif peer.send_q is not None and self.peers.get(peer.idx) is peer:
+            await peer.send_q.put(msg)
+        else:
+            # pre-registration writes (none today) fall through direct
+            await write_message(peer.writer, msg)
 
     async def _forward(self, msg: Message, exclude: int | None = None,
                        limit: int = 0) -> None:
@@ -594,33 +708,66 @@ class P2PNode:
         gossiper.py:66-112): on dense overlays every receiver
         re-forwarding to ALL peers is O(peers^2) per flood; capped
         epidemic relay with at-most-once dedup reaches everyone whp
-        in O(log n) hops at O(peers * fanout) traffic."""
+        in O(log n) hops at O(peers * fanout) traffic.
+
+        Never serializes on a slow peer: idle lanes are written inline
+        (synchronous, cheap); congested lanes are enqueued CONCURRENTLY
+        — before round 7 this was a sequential write-then-drain loop,
+        so one wedged TCP buffer stalled the fanout to every peer
+        behind it."""
         targets = [p for p in self.peers.values() if p.idx != exclude]
         if limit > 0 and len(targets) > limit:
             targets = self._rng.sample(targets, limit)
-        for peer in targets:
+        congested = [
+            p for p in targets
+            if self.shaper is not None or not self._try_fast_write(p, msg)
+        ]
+        if not congested:
+            return
+
+        async def enqueue(peer: PeerState) -> None:
             try:
                 await self._write(peer, msg)
             except (ConnectionError, RuntimeError):
                 self._drop_conn(peer)
 
-    async def _send_params(self, peer: PeerState, params, contributors,
+        await asyncio.gather(*(enqueue(p) for p in congested))
+
+    async def _send_params(self, peers, params, contributors,
                            weight, **body) -> None:
+        """Ship a weights payload to one peer or a list of peers.
+
+        The Message is built ONCE for the whole target list: the
+        payload encode, the content hash, the signature, and the framed
+        header are all per-message-lifetime costs — every additional
+        recipient costs only a queue put of the same object (the frame
+        memo makes the drain tasks reuse identical segments)."""
+        if isinstance(peers, PeerState):
+            peers = [peers]
+        if not peers:
+            return
         body.setdefault("round", self.round)
         blob = encode_parameters(params, tuple(contributors), int(weight))
-        try:
-            await self._write(
-                peer,
-                self._sign(
-                    Message(MsgType.PARAMS, self.idx, body, payload=blob,
-                            # explicit id: PARAMS is a direct message,
-                            # but proxies relay it and need at-most-once
-                            # dedup
-                            msg_id=secrets.token_hex(8))
-                ),
-            )
-        except (ConnectionError, RuntimeError):
-            self._drop_conn(peer)
+        msg = self._sign(
+            Message(MsgType.PARAMS, self.idx, body, payload=blob,
+                    # explicit id: PARAMS is a direct message, but
+                    # proxies relay it and need at-most-once dedup
+                    msg_id=secrets.token_hex(8))
+        )
+        congested = [
+            p for p in peers
+            if self.shaper is not None or not self._try_fast_write(p, msg)
+        ]
+        if not congested:
+            return
+
+        async def ship(peer: PeerState) -> None:
+            try:
+                await self._write(peer, msg)
+            except (ConnectionError, RuntimeError):
+                self._drop_conn(peer)
+
+        await asyncio.gather(*(ship(p) for p in congested))
 
     # ------------------------------------------------------------------
     # control plane loops
@@ -808,8 +955,10 @@ class P2PNode:
             # wait for the initializer's weights
             while not self.initialized:
                 await asyncio.sleep(self.gossip_period_s)
+        self.learn_t0 = time.monotonic()
         while self.round < self.total_rounds:
             await self._train_round()
+        self.learn_t1 = time.monotonic()
         # final evaluation, shared with the federation (the metrics
         # flood the reference stubbed out, node.py:611-620 + 875-878)
         try:
@@ -841,12 +990,17 @@ class P2PNode:
             any(not self._progress(i).initialized for i in self.peers)
             and loop.time() < deadline
         ):
+            now = loop.time()
+            due = []
             for idx, peer in list(self.peers.items()):
-                now = loop.time()
                 if (not self._progress(idx).initialized
                         and now - last_sent.get(idx, -1e9) >= retry_s):
                     last_sent[idx] = now
-                    await self._send_params(peer, params, (), 1, init=True)
+                    due.append(peer)
+            if due:
+                # one encode+sign for the whole sweep — every due peer
+                # gets the same Message object off its own send lane
+                await self._send_params(due, params, (), 1, init=True)
             await asyncio.sleep(self.gossip_period_s)
 
     def _effective_role(self) -> str:
@@ -915,11 +1069,10 @@ class P2PNode:
                 [self.peers[target]] if target is not None
                 else list(self.peers.values())
             )
-            for peer in sent_to:
-                await self._send_params(
-                    peer, self.learner.get_parameters(), (self.idx,),
-                    n_samples,
-                )
+            await self._send_params(
+                sent_to, self.learner.get_parameters(), (self.idx,),
+                n_samples,
+            )
             await self._wait_done()
         else:  # idle / proxy: adopt whatever aggregate arrives
             await self._wait_done()
@@ -1040,10 +1193,9 @@ class P2PNode:
                     )
                 else:
                     # no direct link: hand the partial to proxies to
-                    # relay (node.py:492-515)
-                    for peer in proxies:
-                        await self._send_params(peer, params, contribs,
-                                                weight)
+                    # relay (node.py:492-515) — one Message for all
+                    await self._send_params(proxies, params, contribs,
+                                            weight)
             # convergence exit (node.py:761-777, GOSSIP_EXIT_ON_X_EQUAL_
             # ROUNDS): the reference's gossip tick is 1 Hz, so "20
             # equal rounds" means ~20 quiet SECONDS — measure quiet
@@ -1072,11 +1224,11 @@ class P2PNode:
             or (leader_at_start == self.idx and role == "aggregator")
         ):
             params, contribs = self.session.result
-            for peer in list(self.peers.values()):
-                await self._send_params(
-                    peer, params, contribs or tuple(sorted(train_set)), 1,
-                    aggregated=True,
-                )
+            await self._send_params(
+                list(self.peers.values()),
+                params, contribs or tuple(sorted(train_set)), 1,
+                aggregated=True,
+            )
 
     async def _wait_done(self) -> None:
         deadline = asyncio.get_event_loop().time() + self.session.timeout_s

@@ -1,4 +1,4 @@
-"""Wire protocol: length-prefixed msgpack frames.
+"""Wire protocol: two-segment frames — msgpack header + raw payload.
 
 Message-type parity with the reference's grammar
 (communication_protocol.py:37-54): gossiped (hash-deduped) BEAT /
@@ -6,8 +6,29 @@ ROLE / START_LEARNING / STOP_LEARNING / VOTE_TRAIN_SET / METRICS and
 direct CONNECT / STOP / PARAMS / MODELS_READY / MODELS_AGGREGATED /
 MODEL_INITIALIZED / TRANSFER_LEADERSHIP — minus the parsing hazards:
 no text tokenization, no fixed-size padding, no collapse/incomplete
-reassembly (:497-530), because frames carry an explicit length and the
+reassembly (:497-530), because frames carry explicit lengths and the
 PARAMS payload is the safe envelope from p2pfl_tpu.core.serialize.
+
+Wire format v2 (round 7). v1 embedded the payload INSIDE the msgpack
+frame (``"p": payload``) and then prepended the length — two full
+copies of a tens-of-MB PARAMS blob per encode. v2 frames are::
+
+    magic "P2W2" | >I header_len | msgpack header | payload bytes
+
+The header carries the payload's length (``pl``) and content digest
+(``ph``); the payload itself rides as a separate length-delimited
+segment AFTER the header, so the send path can hand the original
+``bytes`` object to ``StreamWriter.writelines`` untouched and the
+receive path carves it with one ``readexactly`` straight into the
+object handed to ``serialize.unpack``. At most ONE host-side copy of
+the payload exists per hop (the socket read), and the SHA-256 the
+origin signature covers is computed once per message lifetime
+(cached), not once per encode — a relay re-frames without re-hashing.
+
+Version skew is refused loudly in both directions: a v2 reader sees a
+v1 frame's length prefix where the magic belongs and raises; a v1
+reader interprets the v2 magic as a > MAX_FRAME length announcement
+and raises. Neither side can silently misparse the other.
 
 Gossip dedup keeps the reference's at-most-once contract
 (:146-160, :451-461): every gossipable message carries a random
@@ -28,7 +49,12 @@ from typing import Any
 import msgpack
 
 _LEN = struct.Struct(">I")
-MAX_FRAME = 1 << 30  # 1 GiB — a frame is at most one model payload
+#: v2 preamble. First byte 0x50 ('P') makes a v1 reader's length field
+#: read as ~1.3 GB > MAX_FRAME — v1 rejects v2 frames loudly too.
+WIRE_MAGIC = b"P2W2"
+WIRE_VERSION = 2
+MAX_FRAME = 1 << 30  # 1 GiB — a payload is at most one model blob
+MAX_HEADER = 1 << 24  # 16 MiB of control metadata is already absurd
 
 
 class MsgType(enum.Enum):
@@ -104,83 +130,178 @@ class Message:
     # ORIGIN, not the relaying connection (see p2p.tls).
     sig: bytes = b""
     cert: bytes = b""
-    # framed-bytes memo: a broadcast/relay writes the SAME message to
+    # framed-header memo: a broadcast/relay writes the SAME message to
     # up to n-1 peers, and per-peer re-encoding was ~10% of the socket
     # federation's CPU (scripts/exp_socket_profile.py). Set on first
     # encode; _sign() (the only post-construction mutation on the send
     # path) invalidates it.
-    _wire: bytes | None = dataclasses.field(
+    _head: bytes | None = dataclasses.field(
+        default=None, repr=False, compare=False)
+    # payload-digest memo: the SHA-256 the origin signature covers.
+    # Computed at most once per message lifetime — the signer fills it,
+    # the verifier recomputes it from the received bytes (never trusts
+    # the header's copy), and every relay/re-encode reuses it.
+    _payload_digest: bytes | None = dataclasses.field(
         default=None, repr=False, compare=False)
 
     def __post_init__(self):
         if not self.msg_id and self.type in GOSSIPED:
             self.msg_id = secrets.token_hex(8)  # :536-548 hash analog
 
+    def payload_digest(self) -> bytes:
+        """SHA-256 of the payload, computed once and cached (empty for
+        payload-less messages)."""
+        if not self.payload:
+            return b""
+        if self._payload_digest is None:
+            self._payload_digest = hashlib.sha256(self.payload).digest()
+        return self._payload_digest
+
     def signing_bytes(self) -> bytes:
         """Canonical bytes the origin signature covers. msgpack of a
         dict is deterministic across pack→unpack→pack (insertion order
         is preserved), so signer and verifier derive identical bytes.
         The payload enters as a digest: PARAMS blobs are tens of MB and
-        ECDSA hashes its input anyway."""
+        ECDSA hashes its input anyway. Verifiers must call this only
+        with ``_payload_digest`` derived from the RECEIVED payload
+        (decode never seeds it on signed messages)."""
         return msgpack.packb(
             {
                 "t": self.type.value,
                 "s": self.sender,
                 "b": self.body,
-                "ph": hashlib.sha256(self.payload).digest()
-                if self.payload else b"",
+                "ph": self.payload_digest(),
                 "i": self.msg_id,
             },
             use_bin_type=True,
         )
+
+    def wire_segments(self) -> list:
+        """The frame as writev-ready segments: one small bytes object
+        (magic + header length + msgpack header) plus, for non-empty
+        payloads, a ``memoryview`` of the ORIGINAL payload object —
+        the payload is never copied on the send path.
+
+        The header's digest field carries the cached digest when one
+        exists (signing computes it) — it is NOT computed here:
+        plaintext federations never hash payloads at all (the
+        serialize envelope's CRC32 covers integrity), and a measured
+        ~0.7 s/round of the 24-node uncapped round was exactly this
+        hash when it was unconditional."""
+        if self._head is None:
+            ph = self._payload_digest
+            if ph is None and self.sig:
+                ph = self.payload_digest()  # signed: digest is canonical
+            header = msgpack.packb(
+                {
+                    "v": WIRE_VERSION,
+                    "t": self.type.value,
+                    "s": self.sender,
+                    "b": self.body,
+                    "i": self.msg_id,
+                    "g": self.sig,
+                    "c": self.cert,
+                    "pl": len(self.payload),
+                    "ph": ph or b"",
+                },
+                use_bin_type=True,
+            )
+            if len(header) > MAX_HEADER:
+                raise ValueError(f"header too large: {len(header)} bytes")
+            if len(self.payload) > MAX_FRAME:
+                raise ValueError(
+                    f"payload too large: {len(self.payload)} bytes")
+            self._head = WIRE_MAGIC + _LEN.pack(len(header)) + header
+        if not self.payload:
+            return [self._head]
+        return [self._head, memoryview(self.payload)]
 
     def encode(self) -> bytes:
-        if self._wire is not None:
-            return self._wire
-        frame = msgpack.packb(
-            {
-                "t": self.type.value,
-                "s": self.sender,
-                "b": self.body,
-                "p": self.payload,
-                "i": self.msg_id,
-                "g": self.sig,
-                "c": self.cert,
-            },
-            use_bin_type=True,
-        )
-        if len(frame) > MAX_FRAME:
-            raise ValueError(f"frame too large: {len(frame)} bytes")
-        self._wire = _LEN.pack(len(frame)) + frame
-        return self._wire
+        """The full frame as one bytes object. Test/diagnostic helper —
+        the socket send path uses ``wire_segments()`` so the payload is
+        not copied into a contiguous frame."""
+        return b"".join(self.wire_segments())
 
     @staticmethod
-    def decode(frame: bytes) -> "Message":
-        obj = msgpack.unpackb(frame, raw=False)
-        return Message(
+    def _from_header(obj: dict, payload: bytes) -> "Message":
+        if obj.get("v") != WIRE_VERSION:
+            raise ValueError(
+                f"unsupported wire version {obj.get('v')!r} "
+                f"(this node speaks v{WIRE_VERSION})"
+            )
+        msg = Message(
             type=MsgType(obj["t"]),
             sender=int(obj["s"]),
             body=obj.get("b", {}),
-            payload=obj.get("p", b""),
+            payload=payload,
             msg_id=obj.get("i", ""),
             sig=obj.get("g", b""),
             cert=obj.get("c", b""),
         )
+        # Seed the digest cache from the header ONLY for unsigned
+        # messages (plaintext federations): it saves a relay hash and
+        # there is no authenticity to protect. A SIGNED message's
+        # digest must be recomputed from the received payload by the
+        # verifier — trusting the header's copy would let a relay swap
+        # the payload under a valid signature.
+        ph = obj.get("ph", b"")
+        if ph and payload and not msg.sig:
+            msg._payload_digest = ph
+        return msg
+
+    @staticmethod
+    def decode(frame: bytes) -> "Message":
+        """Parse one full v2 frame (as produced by ``encode``)."""
+        mv = memoryview(frame)
+        if bytes(mv[: len(WIRE_MAGIC)]) != WIRE_MAGIC:
+            raise ValueError(
+                "unrecognized wire preamble (legacy v1 or foreign frame)"
+            )
+        off = len(WIRE_MAGIC)
+        (hlen,) = _LEN.unpack_from(mv, off)
+        off += _LEN.size
+        if hlen > MAX_HEADER:
+            raise ValueError(f"oversized header: {hlen}")
+        obj = msgpack.unpackb(mv[off: off + hlen], raw=False)
+        off += hlen
+        pl = int(obj.get("pl", 0))
+        if pl < 0 or pl > MAX_FRAME or off + pl > len(frame):
+            raise ValueError(f"bad payload length: {pl}")
+        payload = bytes(mv[off: off + pl]) if pl else b""
+        return Message._from_header(obj, payload)
 
 
 async def write_message(writer: asyncio.StreamWriter, msg: Message) -> None:
-    writer.write(msg.encode())
+    """Frame ``msg`` onto the stream. ``writelines`` hands the payload
+    memoryview to the transport as-is — no contiguous-frame copy."""
+    writer.writelines(msg.wire_segments())
     await writer.drain()
 
 
 async def read_message(reader: asyncio.StreamReader) -> Message:
-    """Read one frame; raises IncompleteReadError on EOF."""
-    header = await reader.readexactly(_LEN.size)
-    (length,) = _LEN.unpack(header)
-    if length > MAX_FRAME:
-        raise ValueError(f"peer announced oversized frame: {length}")
-    frame = await reader.readexactly(length)
-    return Message.decode(frame)
+    """Read one frame; raises IncompleteReadError on EOF and ValueError
+    (loudly, never a misparse) on version skew or bogus lengths."""
+    # one read for magic + header length: control frames dominate the
+    # frame count (~400k per 24-node round pair), so awaits-per-frame
+    # are a measured cost
+    pre = await reader.readexactly(len(WIRE_MAGIC) + _LEN.size)
+    if pre[: len(WIRE_MAGIC)] != WIRE_MAGIC:
+        raise ValueError(
+            f"unrecognized wire preamble {pre[:4]!r}: peer speaks a "
+            f"different wire version (v1 frames are refused, not parsed)"
+        )
+    (hlen,) = _LEN.unpack_from(pre, len(WIRE_MAGIC))
+    if hlen > MAX_HEADER:
+        raise ValueError(f"peer announced oversized header: {hlen}")
+    obj = msgpack.unpackb(await reader.readexactly(hlen), raw=False)
+    pl = int(obj.get("pl", 0))
+    if pl < 0 or pl > MAX_FRAME:
+        raise ValueError(f"peer announced bad payload length: {pl}")
+    # the ONE host-side copy of the payload on the receive path: the
+    # socket read itself. The returned bytes object is handed to
+    # serialize.unpack without further slicing.
+    payload = await reader.readexactly(pl) if pl else b""
+    return Message._from_header(obj, payload)
 
 
 class DedupRing:

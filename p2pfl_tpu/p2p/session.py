@@ -48,6 +48,14 @@ class AggregationSession:
         self.done = asyncio.Event()
         self.result: tuple[Params, tuple[int, ...]] | None = None
         self._deadline: float | None = None
+        # partial-aggregation memo: the gossip loop asks for the same
+        # (store, peer-coverage) combination every tick and for every
+        # same-coverage target, and each miss costs a tree_stack +
+        # aggregator pass on device. Keyed by the peer's coverage set;
+        # invalidated whenever the store changes.
+        self._partial_memo: dict[
+            frozenset[int], tuple[Params, tuple[int, ...], float] | None
+        ] = {}
 
     # -- setup ----------------------------------------------------------
     def set_nodes_to_aggregate(self, train_set) -> None:
@@ -96,6 +104,7 @@ class AggregationSession:
         for key in evict:
             del self.models[key]
         self.models[contrib] = (params, float(weight))
+        self._partial_memo.clear()  # store changed; memoed partials stale
         if self.train_set and self.covered >= self.train_set:
             self._finish()
         return tuple(sorted(self.covered))
@@ -105,12 +114,16 @@ class AggregationSession:
         self, peer_has
     ) -> tuple[Params, tuple[int, ...], float] | None:
         """Aggregate of stored models containing no contributor the
-        peer already has; None if there is nothing new to send."""
+        peer already has; None if there is nothing new to send.
+        Memoized per peer-coverage set until the store changes."""
         peer = frozenset(int(i) for i in peer_has)
+        if peer in self._partial_memo:
+            return self._partial_memo[peer]
         send = [
             (p, k, w) for k, (p, w) in self.models.items() if not (k & peer)
         ]
         if not send:
+            self._partial_memo[peer] = None
             return None
         params, contribs, weight = self._aggregate(
             [(p, w) for p, k, w in send]
@@ -118,7 +131,9 @@ class AggregationSession:
         all_contrib: frozenset[int] = frozenset()
         for _, k, _ in send:
             all_contrib = all_contrib | k
-        return params, tuple(sorted(all_contrib)), weight
+        out = (params, tuple(sorted(all_contrib)), weight)
+        self._partial_memo[peer] = out
+        return out
 
     # -- completion -------------------------------------------------------
     def check_and_run(self) -> bool:
@@ -143,14 +158,39 @@ class AggregationSession:
         if len(entries) == 1:
             p, w = entries[0]
             return p, (), w
-        stacked = tree_stack([jax.tree.map(np.asarray, p) for p, _ in entries])
         weights = np.asarray([w for _, w in entries], np.float32)
+        if type(self.aggregator) is FedAvg:
+            # Host fast path. Models in the socket session are host
+            # arrays on both sides (deserialized on arrival, re-encoded
+            # on send), and the entry count varies with gossip timing —
+            # pushing every combination through jnp.stack + eager XLA
+            # reductions compiles a fresh program per distinct stack
+            # size mid-round (measured: ~450 compiles / 2 rounds on the
+            # 24-node uncapped bench, ~30% of wall). A numpy weighted
+            # mean is shape-oblivious and stays off-device.
+            total = float(weights.sum())
+            if total > 0:
+                wn = weights / total
+            else:  # tree_weighted_mean degenerate-case parity
+                wn = np.full_like(weights, 1.0 / len(entries))
+                total = float(len(entries))
+            trees = [jax.tree.map(np.asarray, p) for p, _ in entries]
+
+            def leaf(*xs):
+                acc = np.asarray(xs[0], np.float32) * wn[0]
+                for wi, x in zip(wn[1:], xs[1:]):
+                    acc += np.asarray(x, np.float32) * wi
+                return acc.astype(np.asarray(xs[0]).dtype)
+
+            return jax.tree.map(leaf, *trees), (), total
+        stacked = tree_stack([jax.tree.map(np.asarray, p) for p, _ in entries])
         agg = self.aggregator(stacked, weights)
         return jax.tree.map(np.asarray, agg), (), float(weights.sum())
 
     def clear(self) -> None:
         """Reset for the next round (aggregator.py:231-238)."""
         self.models.clear()
+        self._partial_memo.clear()
         self.train_set = frozenset()
         self.waiting = False
         self.result = None

@@ -20,7 +20,17 @@ MessageSigner/MessageVerifier close that hole with per-message origin
 signatures: the originator signs the frame's canonical bytes with its
 TLS key and attaches its certificate; receivers chain the cert to the
 pinned scenario CA, require CN == node<sender>, and verify the
-signature. Short-term replay is absorbed by the gossip dedup ring
+signature. The signed bytes cover the payload only through its SHA-256
+digest (protocol.Message.signing_bytes), so the round-7 two-segment
+wire format changes nothing here: the digest is computed once when the
+origin signs, cached on the Message, and relays re-frame the header
+without rehashing the payload; verifiers always recompute the digest
+from the bytes they received, never trusting the header's copy.
+``asyncio.start_server(ssl=...)`` wraps the same StreamReader/Writer
+pair the plaintext path uses, so writelines-vectored sends and the
+payload-segment reads work unchanged over TLS (the SSL transport
+copies into its encryption buffer — that copy is the cipher's, not the
+framing's). Short-term replay is absorbed by the gossip dedup ring
 (msg_id is inside the signed bytes); a replay after ring eviction can
 only re-deliver a message the origin really sent, and every handler a
 late replay could bite is fenced: ballots and leadership transfers

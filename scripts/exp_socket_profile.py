@@ -12,7 +12,21 @@ into the candidate sinks the verdict names:
 Also sweeps the cheapest candidate knobs (gossip tick, fanout) to
 find a win or document the floor.
 
+Round 7 additions, matching the v2 two-segment wire format (header +
+raw payload segment, docs/architecture.md):
+
+- ``--train-set-size N`` profiles the uncapped payload-bound round
+  (N=24: every node trains and gossips full models — the config the
+  zero-copy data plane was A/B'd on, docs/perf.md §7);
+- ``--multiproc K`` runs the scenario through ``p2p.launch`` with K
+  nodes per child process (K=1 -> 24 processes, K=4 -> 6) instead of
+  the in-process simulation, reporting the per-layout round time the
+  bench's ``socket_round_s_24node_multiproc`` key records. cProfile
+  cannot cross process boundaries, so this mode reports timing only —
+  profile a single child by running it under ``python -m cProfile``.
+
 Usage: python scripts/exp_socket_profile.py [--rounds 3] [--sweep]
+         [--train-set-size 8] [--multiproc K]
 """
 
 from __future__ import annotations
@@ -71,17 +85,54 @@ def run_once(**kw):
     return out, wall
 
 
+def run_multiproc(nodes_per_proc: int, **kw) -> None:
+    """The scenario through real OS processes (p2p.launch), timing only
+    — matches bench._socket_mp's method: round time = the slowest
+    node's post-warm-up round-loop wall (learn_wall_s) / rounds."""
+    import tempfile
+
+    from p2pfl_tpu.p2p.launch import launch
+
+    cfg = _cfg(**kw)
+    rounds = cfg.training.rounds
+    with tempfile.TemporaryDirectory() as td:
+        path = Path(td) / "sockprof.json"
+        cfg.save(path)
+        t0 = time.monotonic()
+        results = launch(cfg, path, platform="cpu",
+                         nodes_per_proc=nodes_per_proc)
+        wall = time.monotonic() - t0
+    walls = [r["learn_wall_s"] for r in results if r.get("learn_wall_s")]
+    layout = (f"{len(range(0, cfg.n_nodes, nodes_per_proc))}x"
+              f"{nodes_per_proc}")
+    print(f"multiproc {layout}: nodes_done="
+          f"{sum(r.get('round') == rounds for r in results)}"
+          f"/{cfg.n_nodes} round_s="
+          f"{round(max(walls) / rounds, 3) if walls else None} "
+          f"total_wall={wall:.1f}s", flush=True)
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--rounds", type=int, default=3)
     ap.add_argument("--sweep", action="store_true")
+    ap.add_argument("--train-set-size", type=int, default=8)
+    ap.add_argument("--multiproc", type=int, default=None, metavar="K",
+                    help="run via p2p.launch with K nodes/process "
+                         "instead of in-process simulation (no profile)")
     args = ap.parse_args()
+
+    if args.multiproc:
+        run_multiproc(args.multiproc, rounds=args.rounds,
+                      train_set_size=args.train_set_size)
+        return
 
     # ---- attribution run under cProfile ------------------------------
     prof = cProfile.Profile()
     t_cpu0 = time.process_time()
     prof.enable()
-    out, wall = run_once(rounds=args.rounds)
+    out, wall = run_once(rounds=args.rounds,
+                         train_set_size=args.train_set_size)
     prof.disable()
     cpu = time.process_time() - t_cpu0
     print(f"baseline: round_s={out.get('round_s')} wall={wall:.1f}s "

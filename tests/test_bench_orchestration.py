@@ -29,7 +29,7 @@ def test_zero_budget_still_emits_parseable_json():
     # with zero budget (t_end == t_start, remaining negative
     # everywhere), every phase is explicitly accounted as skipped
     assert set(out["skipped_phases"]) == {
-        "headline", "cifar16", "cpu8", "socket24", "vit32"
+        "headline", "cifar16", "cpu8", "socket24", "socket_mp", "vit32"
     }
 
 

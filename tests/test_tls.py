@@ -12,6 +12,8 @@ import ssl
 import numpy as np
 import pytest
 
+pytest.importorskip("cryptography")  # container images without it skip
+
 from p2pfl_tpu.config.schema import DataConfig, ProtocolConfig
 from p2pfl_tpu.datasets import FederatedDataset
 from p2pfl_tpu.learning import JaxLearner
@@ -21,6 +23,13 @@ from p2pfl_tpu.p2p.tls import (
     load_node_credentials,
     make_scenario_credentials,
 )
+
+# leaked peers from the concurrent-drain send lanes must fail loudly:
+# an unclosed socket or a never-awaited coroutine is a bug, not noise
+pytestmark = [
+    pytest.mark.filterwarnings("error::ResourceWarning"),
+    pytest.mark.filterwarnings("error:.*was never awaited:RuntimeWarning"),
+]
 
 _PROTO = ProtocolConfig(heartbeat_period_s=0.2, aggregation_timeout_s=20.0,
                         vote_timeout_s=5.0)
@@ -50,8 +59,12 @@ def test_credentials_roundtrip(tmp_path):
 
 
 def test_encrypted_federation_converges(tmp_path):
+    """n=4 over the round-7 two-segment framing: PARAMS payload
+    segments and vectored writes must survive the SSL transport, and
+    the cached signing digest must hold up across relays."""
+
     async def main():
-        n = 3
+        n = 4
         creds = make_scenario_credentials(tmp_path, n, name="enc")
         learners = _learners(n)
         nodes = [

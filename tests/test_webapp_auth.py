@@ -242,6 +242,49 @@ def test_read_surface_gated_when_users_configured(auth_server):
     assert code == 200 and "logged in as viewer" in page
 
 
+def test_login_csrf_stance(auth_server):
+    """Pin the documented /login CSRF stance (docs/webapp.md): the
+    PRE-SESSION login POST dispatches without any CSRF token — no
+    double-submit cookie is minted — and the defense it relies on is
+    the session cookie's own attributes: SameSite=Strict + HttpOnly.
+    If either attribute disappears from Set-Cookie, or /login starts
+    demanding a token (breaking curl automation), this fails."""
+    import urllib.request as _rq
+
+    # no cookie jar, no prior GET, no csrf field — the bare automation
+    # POST the docs promise keeps working
+    body = urllib.parse.urlencode(
+        {"user": "root", "password": "rootpw"}).encode()
+    req = _rq.Request(auth_server + "/login", data=body, method="POST")
+    opener = _rq.build_opener(_rq.HTTPRedirectHandler)
+
+    class _NoRedirect(_rq.HTTPRedirectHandler):
+        def redirect_request(self, *a, **kw):
+            return None
+
+    opener = _rq.build_opener(_NoRedirect)
+    try:
+        resp = opener.open(req, timeout=10)
+        code, headers = resp.status, resp.headers
+    except urllib.error.HTTPError as e:  # 303 surfaces as HTTPError
+        code, headers = e.code, e.headers
+    assert code == 303
+    cookie = headers.get("Set-Cookie", "")
+    assert cookie.startswith("p2pfl_session=")
+    assert "SameSite=Strict" in cookie and "HttpOnly" in cookie
+
+    # and a wrong password must NOT mint a session cookie at all
+    bad = urllib.parse.urlencode(
+        {"user": "root", "password": "nope"}).encode()
+    try:
+        resp = opener.open(_rq.Request(auth_server + "/login", data=bad,
+                                       method="POST"), timeout=10)
+        code, headers = resp.status, resp.headers
+    except urllib.error.HTTPError as e:
+        code, headers = e.code, e.headers
+    assert code == 401 and "Set-Cookie" not in headers
+
+
 def test_read_surface_open_without_user_store(tmp_path):
     """No --users: token-only servers keep the open read surface
     (rounds 1-3 behavior; nothing to log in AS)."""

@@ -126,12 +126,14 @@ def _build(n: int, *, dataset="femnist", model="femnist-cnn",
            optimizer="sgd", momentum_dtype=None,
            exchange_dtype="bf16", seed=0,
            model_kwargs=None, shared_aggregate=False,
-           surrogate_profile="hard"):
+           surrogate_profile="hard",
+           attack=None, malicious=None, reputation=False):
     """Assemble one federated configuration into compiled programs.
 
     Returns a dict of everything the timing/trajectory helpers need.
     """
     import jax.numpy as jnp
+    import numpy as np
 
     from p2pfl_tpu.config.schema import DataConfig
     from p2pfl_tpu.datasets import FederatedDataset
@@ -177,7 +179,9 @@ def _build(n: int, *, dataset="femnist", model="femnist-cnn",
         build_round_fn(fns, aggregator=aggregator, epochs=1,
                        exchange_dtype=ex_dt,
                        shared_aggregate=shared_aggregate,
-                       identity_adopt=True)  # _build is always DFL
+                       identity_adopt=True,  # _build is always DFL
+                       attack=attack, malicious=malicious,
+                       update_stats=reputation)
     )
     shard = int(x.shape[1])
     bsz = min(batch_size, shard)
@@ -194,6 +198,8 @@ def _build(n: int, *, dataset="femnist", model="femnist-cnn",
         "n": n, "ds": ds, "fns": fns, "tr": tr, "fed": fed,
         "fargs": fargs, "round_fn": round_fn, "reset": reset,
         "aggregator": aggregator,
+        "attack": attack, "malicious": malicious,
+        "reputation": reputation, "mix_host": np.asarray(plan.mix),
         "shard": shard, "used": (shard // bsz) * bsz,
         "config": dict(dataset=dataset, model=model, topology=topology,
                        partition=partition, batch_size=batch_size,
@@ -264,6 +270,8 @@ def _rebuild_body_round(run):
         epochs=1, exchange_dtype=ex_dt,
         shared_aggregate=cfg.get("shared_aggregate", False),
         identity_adopt=True,
+        attack=run.get("attack"), malicious=run.get("malicious"),
+        update_stats=bool(run.get("reputation")),
     )
 
 
@@ -1063,6 +1071,102 @@ def _phase_vit32() -> None:
     _part(_vit32(timeout_s=deadline))
 
 
+def _robust_final_acc(run, rounds: int = 12, eval_samples: int = 2000
+                      ) -> float:
+    """Final mean test accuracy after ``rounds`` per-round dispatches.
+
+    Per-round (not the fused fori trajectory) because the reputation
+    variant rescales the mixing matrix's columns between rounds from
+    host-side trust state — mix is runtime data, so no recompile."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from p2pfl_tpu.parallel.federated import build_eval_fn
+
+    tr, ds, fns = run["tr"], run["ds"], run["fns"]
+    xt = tr.put_replicated(jnp.asarray(ds.x_test[:eval_samples]))
+    yt = tr.put_replicated(jnp.asarray(ds.y_test[:eval_samples]))
+    eval_jit = jax.jit(build_eval_fn(fns))
+    round_jit = jax.jit(_rebuild_body_round(run), donate_argnums=(0,))
+    run["fed"] = None  # _accuracy_run's memory note: one live state
+    fed = run["reset"](1)
+    fargs = list(run["fargs"])
+    mon = None
+    if run.get("reputation"):
+        from p2pfl_tpu.adversary import ReputationMonitor
+
+        mon = ReputationMonitor(run["n"])
+    for _ in range(rounds):
+        if mon is not None:
+            mix = run["mix_host"].astype(np.float32)
+            mix = mix * mon.weights_vector()[None, :]
+            fargs[4] = tr.put_stacked(jnp.asarray(mix))
+        fed, m = round_jit(fed, *fargs)
+        if mon is not None and "trust_obs" in m:
+            mon.observe(np.asarray(m["trust_obs"], np.float64))
+    ev = eval_jit(fed, xt, yt)
+    return float(np.mean(np.asarray(ev["accuracy"])))
+
+
+def _phase_robust() -> None:
+    """Robustness under attack: femnist-cnn, 16 nodes, fully connected,
+    25% sign-flip (scale 10). Records ``robust_acc_<attack>_<agg>`` for
+    undefended FedAvg and each defense, plus the clean baseline and the
+    attack transform's round-time overhead. Each variant is emitted as
+    its own part (a mid-phase kill keeps the earlier ones).
+
+    ``P2PFL_ROBUST_DRY=1`` emits the variant plan without touching the
+    accelerator — the orchestration test's smoke hook."""
+    from p2pfl_tpu.adversary import AttackSpec, malicious_indices
+    from p2pfl_tpu.core.aggregators import Krum, TrimmedMean
+
+    n, rounds = 16, 12
+    variants = [
+        ("robust_acc_clean_fedavg", None, None, False),
+        ("robust_acc_signflip_fedavg", "signflip", None, False),
+        ("robust_acc_signflip_krum", "signflip", Krum(f=4, m=8), False),
+        ("robust_acc_signflip_trimmedmean", "signflip",
+         TrimmedMean(beta=4), False),
+        ("robust_acc_signflip_repfedavg", "signflip", None, True),
+    ]
+    if os.environ.get("P2PFL_ROBUST_DRY") == "1":
+        _part({"robust_dry": True, "robust_rounds": rounds,
+               "robust_n_nodes": n, "robust_malicious_fraction": 0.25,
+               "robust_variants": [v[0] for v in variants]})
+        return
+
+    import jax
+
+    mal = malicious_indices(n, 0.25, seed=0)
+    kw = dict(topology="fully", samples_per_node=256, batch_size=64)
+    clean_round_s = None
+    for key, kind, agg, rep in variants:
+        try:
+            spec = (AttackSpec(kind=kind, scale=10.0, seed=0)
+                    if kind else None)
+            run = _build(n, aggregator=agg, attack=spec,
+                         malicious=mal if kind else None,
+                         reputation=rep, **kw)
+            part = {}
+            # transform overhead: the poison is a pure pytree op inside
+            # the jitted round — measure it on the two FedAvg builds
+            # (timing first: the accuracy run frees run["fed"])
+            if key == "robust_acc_clean_fedavg":
+                clean_round_s = _time_rounds_synced(run, reps=3)
+            elif key == "robust_acc_signflip_fedavg" and clean_round_s:
+                atk_s = _time_rounds_synced(run, reps=3)
+                part["robust_attack_overhead_pct"] = round(
+                    100.0 * (atk_s - clean_round_s) / clean_round_s, 2)
+            part[key] = round(_robust_final_acc(run, rounds=rounds), 4)
+            _part(part)
+            run.clear()
+            jax.clear_caches()
+        except Exception as e:
+            print(f"robust variant {key} failed: {e!r}"[:300],
+                  file=sys.stderr, flush=True)
+
+
 def _phase_selftest() -> None:
     """Test hook (tests/test_bench_orchestration.py): emit one part,
     then crash — exercises the parent's guarantee that parts from a
@@ -1202,6 +1306,7 @@ def main() -> None:
         ("cpu8", "_phase_cpu8", 45),
         ("socket24", "_phase_socket24", 45),
         ("socket_mp", "_phase_socket_mp", 150),
+        ("robust", "_phase_robust", 150),
         ("vit32", "_phase_vit32", 120),
     ]
     for name, fn, min_s in phases:

@@ -1,0 +1,39 @@
+"""Adversary & trust subsystem: attack injection + reputation.
+
+``attacks``    pure pytree transforms on outgoing updates, applied
+               identically (bit-for-bit) by the SPMD round fn and the
+               socket node — see attacks.py.
+``reputation`` per-peer EWMA trust from round-wise update statistics,
+               feeding reputation-weighted aggregation on both paths —
+               see reputation.py.
+"""
+
+from p2pfl_tpu.adversary.attacks import (
+    ATTACKS,
+    MODEL_ATTACKS,
+    AttackSpec,
+    attack_key,
+    flip_labels,
+    malicious_indices,
+    poison_stacked,
+    poison_update,
+)
+from p2pfl_tpu.adversary.reputation import (
+    ReputationMonitor,
+    cohort_scores,
+    spmd_trust_obs,
+)
+
+__all__ = [
+    "ATTACKS",
+    "MODEL_ATTACKS",
+    "AttackSpec",
+    "attack_key",
+    "flip_labels",
+    "malicious_indices",
+    "poison_stacked",
+    "poison_update",
+    "ReputationMonitor",
+    "cohort_scores",
+    "spmd_trust_obs",
+]

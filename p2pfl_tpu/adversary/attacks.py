@@ -1,0 +1,197 @@
+"""Adversarial transforms on outgoing model updates.
+
+Fedstellar's defining workload is federations *under attack*
+(fedstellar/attacks/aggregation.py: label flipping, sample poisoning,
+model poisoning; SURVEY §3.6) — this module is its TPU-native
+re-design. Every model-level attack is ONE pure, jit-compatible pytree
+transform ``poison_update(params, ref, node_idx, round_num, spec)``:
+
+- the SPMD simulation path applies it inside the jitted round fn to
+  the rows of the stacked params selected by a STATIC malicious mask
+  (``poison_stacked`` below — a trace-time Python loop over the
+  malicious indices, so the math per node is literally the same
+  function call the socket path makes);
+- the socket path applies it on the host (CPU backend) to the
+  learner's trained params before they enter the node's own session
+  and every ``_send_params``.
+
+Same seed + same (node, round) ⇒ **bit-identical** poisoned leaves on
+both paths — pinned by tests/test_adversary.py with tolerance 0. That
+parity is what makes a robustness number measured on the fast SPMD
+path transferable to the socket deployment.
+
+``ref`` is the params the node started the round from (the previous
+aggregate it trained on): delta-space attacks (sign-flip, scaled
+poisoning, free-riding) are defined against it. The label-flip data
+poisoning acts at the learner level instead (``flip_labels``) and
+leaves the update transform as identity.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Params = Any
+
+#: model-level update transforms + the learner-level data attack
+ATTACKS = ("none", "signflip", "scale", "noise", "freerider", "labelflip")
+
+#: attacks that transform the outgoing update (vs poisoning the data)
+MODEL_ATTACKS = ("signflip", "scale", "noise", "freerider")
+
+
+@dataclasses.dataclass(frozen=True)
+class AttackSpec:
+    """What a malicious node does to its outgoing update.
+
+    ``kind``  one of :data:`ATTACKS`.
+    ``scale`` delta amplification factor (signflip/scale) or the
+              noise standard deviation multiplier (noise).
+    ``seed``  PRNG root for stochastic attacks; combined with
+              (node_idx, round_num) via ``fold_in`` so every node and
+              round draws distinct — but path-independent — noise.
+    """
+
+    kind: str = "none"
+    scale: float = 10.0
+    seed: int = 0
+
+    def __post_init__(self):
+        if self.kind not in ATTACKS:
+            raise ValueError(
+                f"unknown attack kind {self.kind!r}; have {ATTACKS}"
+            )
+
+    @property
+    def poisons_updates(self) -> bool:
+        return self.kind in MODEL_ATTACKS
+
+
+def attack_key(seed: int, node_idx, round_num) -> jax.Array:
+    """Deterministic per-(node, round) key — identical on both paths.
+    ``node_idx``/``round_num`` may be traced ints (SPMD path folds in
+    ``fed.round``)."""
+    key = jax.random.PRNGKey(seed)
+    key = jax.random.fold_in(key, node_idx)
+    return jax.random.fold_in(key, round_num)
+
+
+def poison_update(params: Params, ref: Params, node_idx, round_num,
+                  spec: AttackSpec) -> Params:
+    """Transform ONE node's outgoing update. Pure and jit-compatible;
+    preserves every leaf's shape and dtype.
+
+    - ``signflip``   send ``ref - scale * (params - ref)``: the
+      training delta reversed and amplified — the classic
+      sign-flipping model poisoning.
+    - ``scale``      send ``ref + scale * (params - ref)``: honest
+      direction, amplified — drags the average past the optimum.
+    - ``noise``      add Gaussian noise with std ``scale * std(delta)``
+      per leaf (relative sizing keeps the attack meaningful across
+      layers with very different weight magnitudes).
+    - ``freerider``  send ``ref`` unchanged: a stale echo of the model
+      the node received, contributing nothing while collecting the
+      aggregate (weight-unit free-riding).
+    - ``none``/``labelflip``  identity (labelflip poisons the DATA).
+    """
+    kind = spec.kind
+    if kind in ("none", "labelflip"):
+        return params
+    if kind == "freerider":
+        return jax.tree.map(lambda r, p: r.astype(p.dtype), ref, params)
+    if kind == "signflip":
+        s = jnp.float32(spec.scale)
+        return jax.tree.map(
+            lambda p, r: (r.astype(jnp.float32)
+                          - s * (p.astype(jnp.float32)
+                                 - r.astype(jnp.float32))).astype(p.dtype),
+            params, ref,
+        )
+    if kind == "scale":
+        s = jnp.float32(spec.scale)
+        return jax.tree.map(
+            lambda p, r: (r.astype(jnp.float32)
+                          + s * (p.astype(jnp.float32)
+                                 - r.astype(jnp.float32))).astype(p.dtype),
+            params, ref,
+        )
+    if kind == "noise":
+        key = attack_key(spec.seed, node_idx, round_num)
+        leaves, treedef = jax.tree.flatten(params)
+        ref_leaves = jax.tree.leaves(ref)
+        out = []
+        # per-leaf fold_in by POSITION: the same leaf order falls out
+        # of the same pytree on both paths (serialize round-trips keep
+        # leaf order), so the noise bits match exactly
+        for i, (p, r) in enumerate(zip(leaves, ref_leaves)):
+            lk = jax.random.fold_in(key, i)
+            d = p.astype(jnp.float32) - r.astype(jnp.float32)
+            std = jnp.sqrt(jnp.mean(d * d) + 1e-12)
+            noise = jax.random.normal(lk, p.shape, jnp.float32)
+            out.append(
+                (p.astype(jnp.float32)
+                 + jnp.float32(spec.scale) * std * noise).astype(p.dtype)
+            )
+        return jax.tree.unflatten(treedef, out)
+    raise ValueError(f"unknown attack kind {kind!r}")
+
+
+def poison_stacked(stacked: Params, ref_stacked: Params,
+                   malicious: np.ndarray, round_num,
+                   spec: AttackSpec) -> Params:
+    """Apply :func:`poison_update` to the rows of a ``[n, ...]``-stacked
+    params tree selected by a STATIC boolean ``malicious`` mask.
+
+    The mask must be a host array (compile-time constant): only
+    malicious rows are touched, via a trace-time loop of
+    ``.at[i].set(poison_update(row_i))`` — each poisoned row is the
+    EXACT same per-node computation the socket path runs, which is
+    what makes the two paths bit-identical (vmapping the transform
+    could legally reassociate the arithmetic).
+    """
+    if spec.kind in ("none", "labelflip"):
+        return stacked
+    malicious = np.asarray(malicious, bool)
+    out = stacked
+    for i in np.flatnonzero(malicious):
+        i = int(i)
+        row = jax.tree.map(lambda x: x[i], stacked)
+        ref = jax.tree.map(lambda x: x[i], ref_stacked)
+        poisoned = poison_update(row, ref, i, round_num, spec)
+        out = jax.tree.map(lambda o, v: o.at[i].set(v), out, poisoned)
+    return out
+
+
+def flip_labels(y: np.ndarray, num_classes: int) -> np.ndarray:
+    """Label-flip data poisoning: ``y -> (C - 1) - y`` (the reference's
+    ``labelFlipping`` attack permutes targets; the involution keeps it
+    deterministic and dataset-agnostic). Applied to a malicious node's
+    TRAIN shard only — identical math on the socket path (per-node
+    shard) and the SPMD path (stacked rows), so the two simulations
+    train on the same poisoned bits."""
+    return (num_classes - 1 - np.asarray(y)).astype(np.asarray(y).dtype)
+
+
+def malicious_indices(n_nodes: int, fraction: float, seed: int = 0,
+                      nodes: tuple[int, ...] | list[int] = ()) -> np.ndarray:
+    """The deterministic malicious cohort as a ``[n]`` bool mask.
+
+    Explicit ``nodes`` win; otherwise ``floor(fraction * n)`` nodes are
+    drawn from a seeded permutation — both paths (and both processes of
+    a multi-process federation) compute the same cohort from config
+    alone."""
+    mask = np.zeros(n_nodes, bool)
+    if nodes:
+        mask[list(int(i) for i in nodes)] = True
+        return mask
+    k = int(fraction * n_nodes)
+    if k <= 0:
+        return mask
+    order = np.random.default_rng(seed).permutation(n_nodes)
+    mask[order[:k]] = True
+    return mask

@@ -1,0 +1,220 @@
+"""Per-peer trust from round-wise update statistics.
+
+The defense side of the adversary subsystem: every round, each node's
+update delta (trained params minus the round-start reference) is
+scored against the cohort, the scores feed an EWMA trust state, and
+trust rescales the ``weights`` argument of ``Aggregator.aggregate`` —
+a reputation-weighted FedAvg that needs NO new aggregator math, only
+weight shaping (which also composes with the robust aggregators: a
+zeroed weight is a masked row for Krum/TrimmedMean/FedMedian too).
+
+Scoring (``cohort_scores``) combines two Krum-flavored statistics,
+both computed from one ``[k, d]`` flattened-delta matrix:
+
+- **direction**: cosine of each delta to the cohort's mean UNIT
+  direction. Normalizing before averaging matters: an amplified
+  attack (sign-flip at scale 10) dominates a raw mean, making the
+  honest majority look anti-aligned; unit-normalizing caps every
+  node's pull on the consensus direction at 1.
+- **magnitude**: ``min(|d|, med)/max(|d|, med)`` against the cohort
+  median norm — both a 10x-amplified update and a free-rider's ~zero
+  delta are implausible, and cosine alone cannot see either (the
+  free-rider's direction is undefined, the scaled attack's is honest).
+
+The same formula runs in jnp inside the jitted SPMD round fn (scores
+returned as round metrics) and in numpy inside the socket session
+(entry counts vary with gossip timing — eager jnp here would recompile
+per distinct shape, the exact failure the round-7 numpy fast path
+removed). ``xp`` parametrizes the namespace so there is ONE formula.
+
+What reputation does and does not defend is documented in
+docs/architecture.md (threat model): it is an UNWEIGHTED-majority
+heuristic — it assumes the honest cohort agrees directionally, so it
+degrades under extreme non-IID shards and offers nothing against
+attacks inside the plausibility envelope (small-scale poisoning,
+colluding majorities).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+try:  # jnp is optional at import time: the monitor itself is numpy-only
+    import jax.numpy as jnp
+except Exception:  # pragma: no cover - jax is a hard dep of the repo
+    jnp = None
+
+
+def cohort_scores(deltas, present=None, xp=np):
+    """Score each row of a ``[k, d]`` delta matrix in ``[0, 1]``.
+
+    ``present`` (optional ``[k]`` bool) masks rows out of BOTH the
+    consensus statistics and the output (absent rows score 0). Works
+    under jit with ``xp=jnp`` (fixed shapes, ``where``-masked) and
+    eagerly with ``xp=np``.
+    """
+    eps = 1e-12
+    deltas = deltas.astype(xp.float32)
+    k = deltas.shape[0]
+    pm = (
+        xp.ones((k,), xp.float32) if present is None
+        else present.astype(xp.float32)
+    )
+    norms = xp.sqrt(xp.sum(deltas * deltas, axis=1))
+    # a non-finite delta (diverged / overflowed params) is the worst
+    # possible evidence: drop the row from the consensus AND score it
+    # 0, instead of letting one NaN poison every node's statistics
+    finite = xp.isfinite(norms)
+    pm = pm * finite.astype(xp.float32)
+    norms = xp.where(finite, norms, 0.0)
+    deltas = xp.where(finite[:, None], deltas, 0.0)
+    unit = deltas / (norms + eps)[:, None]
+    # cohort consensus: mean of present UNIT deltas (see module doc)
+    direction = xp.sum(unit * pm[:, None], axis=0) / xp.maximum(
+        xp.sum(pm), 1.0
+    )
+    dnorm = xp.sqrt(xp.sum(direction * direction)) + eps
+    cos = unit @ (direction / dnorm)
+    # magnitude plausibility vs the present-median norm
+    if xp is np:  # numpy: explicit selection (nanmedian warns on
+        vals = norms[pm > 0]  # all-NaN, and shapes may vary anyway)
+        med = np.float32(np.median(vals)) if vals.size else np.float32(0.0)
+    else:  # jnp: fixed-shape nan-masked median, jit-safe
+        med = xp.nanmedian(xp.where(pm > 0, norms, xp.nan))
+        med = xp.where(xp.isnan(med), xp.float32(0.0), med)
+    ratio = (xp.minimum(norms, med) + eps) / (xp.maximum(norms, med) + eps)
+    score = xp.clip(cos, 0.0, 1.0) * ratio
+    return xp.where(pm > 0, score, 0.0)
+
+
+def spmd_trust_obs(params_stacked, ref_stacked, present):
+    """The SPMD round fn's per-node score: flatten each node's delta
+    and score the cohort. jnp, fixed-shape, jit-safe — returned as a
+    round metric and EWMA-folded on the host (ReputationMonitor)."""
+    import jax
+
+    n = jax.tree.leaves(params_stacked)[0].shape[0]
+    deltas = jnp.concatenate(
+        [
+            (p.astype(jnp.float32) - r.astype(jnp.float32)).reshape(n, -1)
+            for p, r in zip(
+                jax.tree.leaves(params_stacked), jax.tree.leaves(ref_stacked)
+            )
+        ],
+        axis=1,
+    )
+    return cohort_scores(deltas, present=present, xp=jnp)
+
+
+class ReputationMonitor:
+    """Host-side EWMA trust state, shared by both execution paths.
+
+    - SPMD: ``observe(scores, mask)`` with the round metric; the
+      scenario multiplies ``weights_vector()`` into the mixing
+      matrix's columns for the NEXT round (trust acts with one round
+      of lag — round 0 is uniform).
+    - socket: ``observe_entries(reference, entries)`` scores a
+      session's stored models at aggregation time (numpy — see module
+      doc), attributing multi-contributor partial aggregates to every
+      contributor; ``entry_scales(keys)`` rescales entry weights.
+
+    ``cutoff`` hard-zeroes the weight of nodes whose trust fell below
+    it: for FedAvg that excludes them from the mean; for robust
+    aggregators a zero weight is a masked row.
+    """
+
+    def __init__(self, n_nodes: int, alpha: float = 0.7,
+                 cutoff: float = 0.15):
+        if not 0.0 < alpha <= 1.0:
+            raise ValueError(f"alpha must be in (0, 1], got {alpha}")
+        self.n_nodes = n_nodes
+        self.alpha = float(alpha)
+        self.cutoff = float(cutoff)
+        self.trust = np.ones(n_nodes, np.float32)
+        # first observation REPLACES the optimistic prior instead of
+        # EWMA-blending with it: blending from 1.0 gives an attacker
+        # scoring ~0 a trust of 1-alpha after round 0 — above any
+        # sane cutoff, so two poisoned aggregates land before
+        # exclusion, which at sign-flip scale 10 is fatal
+        self._seen = np.zeros(n_nodes, bool)
+        #: per-round trust snapshots (monitor/webapp export)
+        self.history: list[list[float]] = []
+
+    # -- observations ---------------------------------------------------
+    def observe(self, scores: np.ndarray, mask: np.ndarray | None = None):
+        """EWMA-fold one round of per-node scores. ``mask`` selects
+        which nodes were actually observed (absent nodes keep their
+        trust — silence is not evidence)."""
+        scores = np.asarray(scores, np.float32)
+        scores = np.where(np.isfinite(scores), scores, 0.0)
+        obs = (
+            np.ones(self.n_nodes, bool) if mask is None
+            else np.asarray(mask, bool)
+        )
+        a = self.alpha
+        blended = np.where(self._seen, (1.0 - a) * self.trust + a * scores,
+                           scores)
+        self.trust = np.where(obs, blended, self.trust).astype(np.float32)
+        self._seen = self._seen | obs
+        self.history.append([float(t) for t in self.trust])
+
+    def observe_entries(self, reference, entries) -> None:
+        """Socket-path observation: ``entries`` is
+        ``[(contributor_frozenset, params_tree), ...]`` from one
+        session; ``reference`` is the round-start params the session's
+        owner trained from. Each entry's delta is scored; an entry's
+        score becomes the observation of EVERY contributor (a partial
+        aggregate containing an attacker is itself anomalous — its
+        honest co-contributors take a transient hit and recover via
+        the EWMA, while the attacker is hit every round)."""
+        import jax
+
+        ref_flat = np.concatenate(
+            [np.asarray(l, np.float32).ravel()
+             for l in jax.tree.leaves(reference)]
+        )
+        keys = [k for k, _ in entries]
+        deltas = np.stack(
+            [
+                np.concatenate(
+                    [np.asarray(l, np.float32).ravel()
+                     for l in jax.tree.leaves(p)]
+                ) - ref_flat
+                for _, p in entries
+            ]
+        )
+        scores = cohort_scores(deltas, xp=np)
+        obs_sum = np.zeros(self.n_nodes, np.float64)
+        obs_cnt = np.zeros(self.n_nodes, np.int64)
+        for key, s in zip(keys, scores):
+            for c in key:
+                if 0 <= c < self.n_nodes:
+                    obs_sum[c] += float(s)
+                    obs_cnt[c] += 1
+        mask = obs_cnt > 0
+        per_node = np.where(mask, obs_sum / np.maximum(obs_cnt, 1), 0.0)
+        self.observe(per_node.astype(np.float32), mask)
+
+    # -- weight shaping --------------------------------------------------
+    def weights_vector(self) -> np.ndarray:
+        """Per-node weight multipliers: trust, hard-zeroed below the
+        cutoff."""
+        return np.where(self.trust < self.cutoff, 0.0, self.trust).astype(
+            np.float32
+        )
+
+    def entry_scales(self, keys) -> np.ndarray:
+        """Per-entry weight multipliers for a session's stored models:
+        the mean trust multiplier of each entry's contributors (an
+        unknown/empty contributor set is left at 1.0 — no evidence,
+        no penalty)."""
+        wv = self.weights_vector()
+        out = []
+        for key in keys:
+            ids = [c for c in key if 0 <= c < self.n_nodes]
+            out.append(float(np.mean(wv[ids])) if ids else 1.0)
+        return np.asarray(out, np.float32)
+
+    def suspects(self) -> list[int]:
+        """Nodes currently below the trust cutoff (status export)."""
+        return [int(i) for i in np.flatnonzero(self.trust < self.cutoff)]

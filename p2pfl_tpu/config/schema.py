@@ -133,6 +133,50 @@ class NetworkConfig:
 
 
 @dataclasses.dataclass
+class AdversaryConfig:
+    """adversary_args: attack injection + reputation defense
+    (successor of the reference's poisoning knobs — fedstellar
+    attacks/aggregation.py + participant.json ``adversarial_args``).
+
+    ``fraction`` of nodes turned malicious (deterministically drawn
+    from ``seed``; ``nodes`` lists explicit indices instead), each
+    applying attack ``kind`` (p2pfl_tpu.adversary.attacks.ATTACKS)
+    with strength ``scale``. ``reputation`` switches on the
+    trust-weighted aggregation defense on whichever execution path
+    runs the scenario (see p2pfl_tpu.adversary.reputation).
+    """
+
+    fraction: float = 0.0
+    kind: str = "none"  # none|signflip|scale|noise|freerider|labelflip
+    scale: float = 10.0
+    seed: int = 0
+    nodes: list[int] = dataclasses.field(default_factory=list)
+    reputation: bool = False
+    reputation_alpha: float = 0.7
+    reputation_cutoff: float = 0.15
+
+    def __post_init__(self):
+        # the attack taxonomy lives in adversary.attacks; import lazily
+        # so the schema stays importable without jax
+        known = ("none", "signflip", "scale", "noise", "freerider",
+                 "labelflip")
+        if self.kind not in known:
+            raise ValueError(
+                f"unknown attack kind {self.kind!r}; have {known}"
+            )
+        if not 0.0 <= self.fraction <= 1.0:
+            raise ValueError(
+                f"adversary fraction must be in [0, 1], got {self.fraction}"
+            )
+
+    @property
+    def active(self) -> bool:
+        return self.kind != "none" and (
+            self.fraction > 0.0 or bool(self.nodes)
+        )
+
+
+@dataclasses.dataclass
 class FaultEvent:
     """Deterministic fault injection: node ``node`` dies at round
     ``round`` (and optionally recovers). The reference can only inject
@@ -174,6 +218,9 @@ class ScenarioConfig:
     aggregator: str = "fedavg"
     aggregator_kwargs: dict[str, Any] = dataclasses.field(default_factory=dict)
     network: NetworkConfig = dataclasses.field(default_factory=NetworkConfig)
+    adversary: AdversaryConfig = dataclasses.field(
+        default_factory=AdversaryConfig
+    )
     # weight-exchange collective schedule: "dense" = all-gather einsum;
     # "sparse" = per-edge-offset ppermute (O(degree) ICI traffic, DFL +
     # one node per device only); "auto" picks sparse when it is legal
@@ -250,6 +297,7 @@ class ScenarioConfig:
             ("training", TrainingConfig),
             ("protocol", ProtocolConfig),
             ("network", NetworkConfig),
+            ("adversary", AdversaryConfig),
         ]:
             if field in d and isinstance(d[field], dict):
                 d[field] = cls(**d[field])

@@ -143,11 +143,41 @@ class Krum(Aggregator):
     def __init__(self, f: int = 1, m: int = 1):
         self.f = f
         self.m = m
+        self._small_cohort_warned = False
 
     def aggregate(self, stacked, weights, mask=None):
         w = _masked_weights(weights, mask)
         present = w > 0
         n = w.shape[0]
+        # Krum's score needs n_present - f - 2 >= 1 closest neighbors;
+        # below that the clip to 1 silently degrades selection to
+        # nearest-single-neighbor, which tolerates NOTHING — fail loud
+        # instead of returning a number that looks Byzantine-robust.
+        # The static row count is checkable even under jit (and a
+        # too-small n can never recover at runtime)...
+        if n < self.f + 3:
+            raise ValueError(
+                f"Krum(f={self.f}) needs at least f+3={self.f + 3} rows "
+                f"to score n_present-f-2 neighbors, got n={n}; lower f "
+                "or use TrimmedMean/FedMedian for small cohorts"
+            )
+        # ...while a dynamic partial mask can only be checked when it
+        # is concrete (eager host-path aggregation); inside a jitted
+        # program the clip below still applies, documented here.
+        if not isinstance(present, jax.core.Tracer):
+            n_present = int(jnp.sum(present))
+            if n_present < self.f + 3 and not self._small_cohort_warned:
+                import warnings
+
+                warnings.warn(
+                    f"Krum(f={self.f}) aggregating only {n_present} "
+                    f"present rows (< f+3={self.f + 3}): neighbor count "
+                    "clipped to 1 — selection is NOT Byzantine-robust "
+                    "this round",
+                    RuntimeWarning,
+                    stacklevel=2,
+                )
+                self._small_cohort_warned = True  # once per instance
 
         flat = jnp.concatenate(
             [x.reshape(n, -1).astype(jnp.float32) for x in jax.tree.leaves(stacked)],

@@ -28,6 +28,12 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from p2pfl_tpu.adversary import (
+    AttackSpec,
+    ReputationMonitor,
+    flip_labels,
+    malicious_indices,
+)
 from p2pfl_tpu.config.schema import ScenarioConfig
 from p2pfl_tpu.core.aggregators import FedAvg, get_aggregator
 from p2pfl_tpu.datasets import FederatedDataset
@@ -136,9 +142,37 @@ class Scenario(Observable):
         self._faults_by_round: dict[int, list] = {}
         for f in config.faults:
             self._faults_by_round.setdefault(f.round, []).append(f)
+        self._base_trains = np.array(
+            [r in ("trainer", "aggregator", "server") for r in self.roles]
+        )
+
+        # ---- adversary wiring: the malicious cohort, the update
+        # transform, and the trust monitor all derive from config alone,
+        # so the SPMD and socket paths agree on who attacks and how
+        adv = config.adversary
+        self.malicious = (
+            malicious_indices(n, adv.fraction, adv.seed, tuple(adv.nodes))
+            if adv.active else np.zeros(n, bool)
+        )
+        self.attack = (
+            AttackSpec(kind=adv.kind, scale=adv.scale, seed=adv.seed)
+            if adv.active else None
+        )
+        self.reputation = (
+            ReputationMonitor(n, alpha=adv.reputation_alpha,
+                              cutoff=adv.reputation_cutoff)
+            if adv.reputation else None
+        )
 
         # ---- device-side setup
         x, y, smask, nsamp = self.dataset.stacked()
+        if self.attack is not None and self.attack.kind == "labelflip":
+            # data poisoning happens at the shard, not the update: flip
+            # the malicious rows of the stacked train labels (identical
+            # math to the socket path flipping its per-node shard)
+            y = np.array(y, copy=True)
+            for i in np.flatnonzero(self.malicious):
+                y[i] = flip_labels(y[i], self.dataset.num_classes)
         tr = self.transport
         self._data_args = tuple(
             tr.put_stacked(jnp.asarray(a)) for a in (x, y, smask, nsamp)
@@ -171,6 +205,9 @@ class Scenario(Observable):
                 # -> the agg[adopt] whole-stack gather pass is elided;
                 # CFL/SDFL adopt the leader's row and keep it
                 identity_adopt=config.federation == "DFL",
+                attack=self.attack,
+                malicious=self.malicious,
+                update_stats=self.reputation is not None,
             )
         self._round_fn = tr.compile_round(round_fn)
         self._eval_fn = tr.compile_eval(build_eval_fn(self.fns))
@@ -217,6 +254,11 @@ class Scenario(Observable):
             cfg.federation == "DFL"
             and self.transport.n_devices == cfg.n_nodes
             and type(self.aggregator) is FedAvg
+            # the ppermute path never materializes the full params
+            # stack, so there is no pre-exchange hook for update
+            # poisoning and no trust_obs metric for reputation
+            and not (self.attack is not None and self.attack.poisons_updates)
+            and self.reputation is None
         )
         if cfg.transport == "dense":
             return False
@@ -224,7 +266,8 @@ class Scenario(Observable):
             if not legal:
                 raise ValueError(
                     "transport='sparse' needs DFL + FedAvg + one node "
-                    f"per device (n_nodes={cfg.n_nodes}, "
+                    "per device, and no update-poisoning adversary or "
+                    f"reputation (n_nodes={cfg.n_nodes}, "
                     f"n_devices={self.transport.n_devices}, "
                     f"federation={cfg.federation})"
                 )
@@ -326,6 +369,30 @@ class Scenario(Observable):
         in on-device from ``fed.alive``, so the plan depends only on the
         leader and the voted train set — cached to avoid per-round
         host→device transfers."""
+        if self.reputation is not None:
+            # reputation-weighted FedAvg without touching the round fn:
+            # w = mix * n_samples * contrib, so scaling mix COLUMN j by
+            # node j's trust is exactly a per-contributor reweighting —
+            # and a zeroed column is a masked row for the robust
+            # aggregators. Trust changes every round, so this path
+            # skips the plan cache (one [n,n] host->device put/round).
+            plan = make_round_plan(
+                self.topology, self.roles, self.config.federation,
+                self.leader,
+            )
+            trains = (
+                plan.trains if trains_override is None else trains_override
+            )
+            mix = (
+                plan.mix.astype(np.float32)
+                * self.reputation.weights_vector()[None, :]
+            )
+            tr = self.transport
+            return (
+                tr.put_stacked(jnp.asarray(mix)),
+                tr.put_stacked(jnp.asarray(plan.adopt)),
+                tr.put_stacked(jnp.asarray(trains)),
+            )
         key = (
             self.leader,
             None if trains_override is None else trains_override.tobytes(),
@@ -372,6 +439,10 @@ class Scenario(Observable):
                     ),
                     "peers": n_alive - 1,
                     "leader": self.leader,
+                    "trust": (
+                        round(float(self.reputation.trust[i]), 4)
+                        if self.reputation is not None else None
+                    ),
                 },
             )
 
@@ -417,9 +488,10 @@ class Scenario(Observable):
                 self.fed = self.fed.replace(
                     alive=self.transport.put_stacked(jnp.asarray(alive))
                 )
+                trains_vote = self._voted_trains(alive, r)
                 self.fed, metrics = self._round_fn(
                     self.fed, *self._data_args,
-                    *self._plan_args(self._voted_trains(alive, r)),
+                    *self._plan_args(trains_vote),
                 )
                 jax.block_until_ready(self.fed.states.params)
                 if tracing:
@@ -432,11 +504,28 @@ class Scenario(Observable):
 
                 train_loss = self._node_host(
                     metrics["train_loss"]).astype(np.float64)
+                if self.reputation is not None and "trust_obs" in metrics:
+                    # round r ran on trust from round r-1 (one-round
+                    # lag); fold in this round's scores for the next.
+                    # Silent nodes (not training or dead) keep their
+                    # trust — absence is not evidence.
+                    contrib = np.logical_and(
+                        self._base_trains if trains_vote is None
+                        else trains_vote,
+                        alive,
+                    )
+                    self.reputation.observe(
+                        self._node_host(metrics["trust_obs"]).astype(
+                            np.float64),
+                        contrib,
+                    )
                 for i in range(cfg.n_nodes):
+                    rec = {"Train/loss": float(train_loss[i]),
+                           "Train/round_time_s": dt}
+                    if self.reputation is not None:
+                        rec["Trust/score"] = float(self.reputation.trust[i])
                     self.logger.log_metrics(
-                        {"Train/loss": float(train_loss[i]),
-                         "Train/round_time_s": dt},
-                        step=self.global_step, round=r, node=i,
+                        rec, step=self.global_step, round=r, node=i,
                     )
                 self._publish_statuses(r, alive, train_loss, ev)
                 if cfg.training.eval_every and (r + 1) % cfg.training.eval_every == 0:

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import argparse
 import asyncio
+import dataclasses
 import json
 import pathlib
 import socket
@@ -32,6 +33,61 @@ from p2pfl_tpu.learning import JaxLearner
 from p2pfl_tpu.models.base import build_model
 from p2pfl_tpu.p2p.node import P2PNode
 from p2pfl_tpu.topology.topology import generate_topology
+
+
+def _adversary_setup(cfg: ScenarioConfig):
+    """(malicious mask, AttackSpec | None, reputation on?) — derived
+    from config alone so every process of a multi-process federation
+    (and the SPMD Scenario) computes the SAME cohort and transforms."""
+    adv = cfg.adversary
+    if not (adv.active or adv.reputation):
+        return None, None, False
+    import numpy as np
+
+    from p2pfl_tpu.adversary import AttackSpec, malicious_indices
+
+    mask = (
+        malicious_indices(cfg.n_nodes, adv.fraction, adv.seed,
+                          tuple(adv.nodes))
+        if adv.active else np.zeros(cfg.n_nodes, bool)
+    )
+    spec = (
+        AttackSpec(kind=adv.kind, scale=adv.scale, seed=adv.seed)
+        if adv.active else None
+    )
+    return mask, spec, adv.reputation
+
+
+def _poison_shard(data: FederatedDataset, idx: int) -> None:
+    """Label-flip data poisoning for one node's TRAIN shard (the
+    stacked SPMD path flips the same rows — Scenario.__init__)."""
+    from p2pfl_tpu.adversary import flip_labels
+
+    nd = data.nodes[idx]
+    data.nodes[idx] = dataclasses.replace(
+        nd, y=flip_labels(nd.y, data.num_classes)
+    )
+
+
+def _node_adversary_kwargs(cfg: ScenarioConfig, idx: int, data, setup):
+    """Per-node P2PNode attack/reputation kwargs (+ shard poisoning as
+    a side effect on ``data``) from one _adversary_setup tuple."""
+    mask, spec, want_rep = setup
+    if mask is None:
+        return {}
+    if spec is not None and spec.kind == "labelflip" and mask[idx]:
+        _poison_shard(data, idx)
+    out = {"attack": spec if (spec is not None and mask[idx]) else None}
+    if want_rep:
+        from p2pfl_tpu.adversary import ReputationMonitor
+
+        # one monitor PER NODE: trust is each node's local view in a
+        # decentralized deployment — no shared state between processes
+        out["reputation"] = ReputationMonitor(
+            cfg.n_nodes, alpha=cfg.adversary.reputation_alpha,
+            cutoff=cfg.adversary.reputation_cutoff,
+        )
+    return out
 
 
 def _declares_full_mesh(cfg) -> bool:
@@ -77,6 +133,8 @@ async def _run_node(cfg: ScenarioConfig, idx: int, ports: list[int],
 
         tls = load_node_credentials(tls_dir, idx)
     data = FederatedDataset.make(cfg.data, n)  # deterministic: same shards
+    adv_kwargs = _node_adversary_kwargs(cfg, idx, data,
+                                        _adversary_setup(cfg))
     learner = JaxLearner(
         model=build_model(cfg.model),
         data=data.nodes[idx],
@@ -103,6 +161,7 @@ async def _run_node(cfg: ScenarioConfig, idx: int, ports: list[int],
         tls=tls,
         netem=cfg.network,
         full_mesh=_declares_full_mesh(cfg),
+        **adv_kwargs,
     )
     await node.start()
     topo = generate_topology(cfg.topology, n, **cfg.topology_kwargs)
@@ -225,6 +284,11 @@ async def _simulate(cfg: ScenarioConfig, timeout: float = 600) -> dict:
         momentum_dtype=cfg.training.momentum_dtype,
         batch_size=cfg.data.batch_size,
     )
+    adv_setup = _adversary_setup(cfg)
+    # shard poisoning mutates data.nodes — run BEFORE learners capture
+    adv_kwargs = [
+        _node_adversary_kwargs(cfg, i, data, adv_setup) for i in range(n)
+    ]
     nodes = [
         P2PNode(
             i,
@@ -239,6 +303,7 @@ async def _simulate(cfg: ScenarioConfig, timeout: float = 600) -> dict:
             seed=cfg.seed,
             netem=cfg.network,
             full_mesh=_declares_full_mesh(cfg),
+            **adv_kwargs[i],
         )
         for i in range(n)
     ]
@@ -277,7 +342,7 @@ async def _simulate(cfg: ScenarioConfig, timeout: float = 600) -> dict:
         (nd.peer_metrics.get(nd.idx) or {} for nd in nodes)
         if m.get("accuracy") is not None
     ]
-    return {
+    out = {
         "n_nodes": n,
         "rounds": min(nd.round for nd in nodes),
         "wall_s": round(wall, 3),
@@ -286,6 +351,20 @@ async def _simulate(cfg: ScenarioConfig, timeout: float = 600) -> dict:
             round(sum(accs) / len(accs), 4) if accs else None
         ),
     }
+    if any(nd.reputation is not None for nd in nodes):
+        # each node's LOCAL trust vector (decentralized: no shared
+        # monitor) + who it would exclude — the robustness tests and
+        # the monitor read these
+        out["trust"] = [
+            [round(float(t), 4) for t in nd.reputation.trust]
+            if nd.reputation is not None else None
+            for nd in nodes
+        ]
+        out["suspects"] = sorted(
+            {s for nd in nodes if nd.reputation is not None
+             for s in nd.reputation.suspects()}
+        )
+    return out
 
 
 def run_simulation(cfg: ScenarioConfig, timeout: float = 600) -> dict:

@@ -114,6 +114,8 @@ class P2PNode:
         tls=None,
         netem=None,
         full_mesh: bool = False,
+        attack=None,
+        reputation=None,
     ):
         from p2pfl_tpu.p2p.session import AggregationSession
 
@@ -164,8 +166,16 @@ class P2PNode:
         from p2pfl_tpu.p2p.netem import shaper_from_config
 
         self.shaper = shaper_from_config(idx, netem, on_error=self._drop_conn)
+        # adversary hooks (p2pfl_tpu.adversary): ``attack`` is an
+        # AttackSpec THIS node applies to its own outgoing update
+        # (a malicious node attacks; honest nodes pass None);
+        # ``reputation`` is a ReputationMonitor shared with the session
+        # so finish-time aggregation is trust-weighted
+        self.attack = attack
+        self.reputation = reputation
         self.session = AggregationSession(
-            aggregator, timeout_s=self.protocol.aggregation_timeout_s
+            aggregator, timeout_s=self.protocol.aggregation_timeout_s,
+            reputation=reputation,
         )
         self.membership = Membership(n_nodes, self.protocol, virtual=False)
         self.peers: dict[int, PeerState] = {}
@@ -1018,6 +1028,23 @@ class P2PNode:
             None, self.learner.fit
         )
 
+    def _poisons_updates(self) -> bool:
+        return self.attack is not None and self.attack.poisons_updates
+
+    def _poison_own_update(self, ref) -> None:
+        """Malicious node: transform the trained params ONCE, in place
+        via set_parameters — the poisoned tree then backs both the own-
+        session add_model AND every _send_params, exactly like the SPMD
+        path's poisoned row entering every mix (its own included).
+        ``ref`` is the round-start params (pre-fit snapshot); keyed by
+        (seed, idx, round) so the SPMD row is bit-identical."""
+        from p2pfl_tpu.adversary.attacks import poison_update
+
+        self.learner.set_parameters(
+            poison_update(self.learner.get_parameters(), ref,
+                          self.idx, self.round, self.attack)
+        )
+
     async def _train_round(self) -> None:
         train_set = await self._vote_train_set()
         self.session.clear()
@@ -1038,6 +1065,12 @@ class P2PNode:
         # waiting node, not mistaken for a regular partial contribution
         if role in ("aggregator", "server"):
             self.session.set_nodes_to_aggregate(train_set)
+            # round-start params: the delta reference for reputation
+            # scoring of everything this session will aggregate (set
+            # BEFORE the pending replay below — a replayed model can
+            # complete coverage and finish the session immediately)
+            if self.reputation is not None:
+                self.session.set_reference(self.learner.get_parameters())
         else:
             self.session.set_waiting_aggregated_model()
         self._round_active = True
@@ -1048,7 +1081,11 @@ class P2PNode:
             if peer.idx in self.peers:
                 await self._on_params(peer, msg)
         if role in ("aggregator", "server"):
+            ref = (self.learner.get_parameters()
+                   if self._poisons_updates() else None)
             await self._fit()
+            if ref is not None:
+                self._poison_own_update(ref)
             n_samples = self.learner.get_num_samples()[0]
             covered = self.session.add_model(
                 self.learner.get_parameters(), (self.idx,), n_samples
@@ -1060,7 +1097,11 @@ class P2PNode:
             )
             await self._gossip_until_done(train_set, role, leader_at_start)
         elif role == "trainer":
+            ref = (self.learner.get_parameters()
+                   if self._poisons_updates() else None)
             await self._fit()
+            if ref is not None:
+                self._poison_own_update(ref)
             n_samples = self.learner.get_num_samples()[0]
             target = (
                 leader_at_start if leader_at_start in self.peers else None

@@ -39,9 +39,16 @@ class AggregationSession:
     """One round's aggregation state for one node."""
 
     def __init__(self, aggregator: Aggregator | None = None,
-                 timeout_s: float = 60.0):
+                 timeout_s: float = 60.0, reputation=None):
         self.aggregator = aggregator or FedAvg()
         self.timeout_s = timeout_s  # AGGREGATION_TIMEOUT
+        #: optional adversary.ReputationMonitor shared across rounds:
+        #: scores this session's entries at finish time and rescales
+        #: their weights by contributor trust (see _finish/_aggregate)
+        self.reputation = reputation
+        #: round-start params of the session's owner — the delta
+        #: reference for reputation scoring (set_reference per round)
+        self.reference: Params | None = None
         self.models: dict[frozenset[int], tuple[Params, float]] = {}
         self.train_set: frozenset[int] = frozenset()
         self.waiting = False
@@ -65,6 +72,11 @@ class AggregationSession:
     def set_waiting_aggregated_model(self) -> None:
         """TRAINER/PROXY/IDLE: adopt the next aggregate received."""
         self.waiting = True
+
+    def set_reference(self, params: Params) -> None:
+        """Round-start params — what this node's cohort trained FROM.
+        Entry deltas for reputation scoring are measured against it."""
+        self.reference = params
 
     # -- state ----------------------------------------------------------
     @property
@@ -150,15 +162,42 @@ class AggregationSession:
         return False
 
     def _finish(self) -> None:
-        params, contribs, _ = self._aggregate(list(self.models.values()))
+        # reputation applies ONLY at finish, never to the partial
+        # aggregates gossiped mid-round: a partial is re-weighted again
+        # inside every receiver's own finish, so scaling it at build
+        # time would compound the trust discount sender x receiver
+        keys = list(self.models.keys())
+        if (self.reputation is not None and self.reference is not None
+                and len(self.models) >= 3):
+            # observe BEFORE aggregating: unlike SPMD (where scores
+            # come out of the jitted round fn and can only shape the
+            # NEXT round's mix), both steps here are host-side at the
+            # same instant — same-round exclusion costs nothing and
+            # stops a first-round attacker before any poisoned
+            # aggregate lands. Under 3 entries the cohort median/
+            # direction is meaningless (2 rows score symmetrically) —
+            # no observation, trust persists.
+            self.reputation.observe_entries(
+                self.reference,
+                [(k, p) for k, (p, _) in self.models.items()],
+            )
+        params, contribs, _ = self._aggregate(
+            list(self.models.values()), keys=keys
+        )
         self.result = (params, tuple(sorted(self.covered)))
         self.done.set()
 
-    def _aggregate(self, entries) -> tuple[Params, tuple[int, ...], float]:
+    def _aggregate(self, entries,
+                   keys=None) -> tuple[Params, tuple[int, ...], float]:
         if len(entries) == 1:
             p, w = entries[0]
             return p, (), w
+        # ONE effective-weights computation feeding BOTH execution
+        # paths below — reputation (or any future weight shaping)
+        # cannot be silently dropped by the numpy fast path
         weights = np.asarray([w for _, w in entries], np.float32)
+        if keys is not None and self.reputation is not None:
+            weights = weights * self.reputation.entry_scales(keys)
         if type(self.aggregator) is FedAvg:
             # Host fast path. Models in the socket session are host
             # arrays on both sides (deserialized on arrival, re-encoded
@@ -191,6 +230,7 @@ class AggregationSession:
         """Reset for the next round (aggregator.py:231-238)."""
         self.models.clear()
         self._partial_memo.clear()
+        self.reference = None  # reputation state itself persists
         self.train_set = frozenset()
         self.waiting = False
         self.result = None

@@ -170,6 +170,9 @@ def build_round_fn(
     exchange_dtype: Any | None = None,
     shared_aggregate: bool = False,
     identity_adopt: bool = False,
+    attack=None,
+    malicious: np.ndarray | None = None,
+    update_stats: bool = False,
 ) -> Callable:
     """Build the jittable ``round_fn(fed, x, y, mask, n_samples, plan
     arrays) -> (fed, metrics)``.
@@ -207,17 +210,51 @@ def build_round_fn(
     runtime index array, so the promise buys one whole-stack memory
     pass per round (~4 ms at the 64-node north star). CFL/SDFL route
     through a leader and must keep the default.
+
+    ``attack`` + ``malicious`` inject adversarial nodes: after local
+    training and BEFORE the weight exchange, the rows of the params
+    stack selected by the STATIC host mask ``malicious`` are replaced
+    by ``adversary.poison_update`` of themselves — the same transform
+    the socket node applies to its outgoing params, keyed by
+    (attack.seed, node index, fed.round) so the two paths poison
+    bit-identically. The mask is a compile-time constant (changing the
+    malicious cohort recompiles — it is scenario config, not round
+    data). ``update_stats=True`` additionally returns per-node trust
+    observations (``metrics["trust_obs"]``, adversary.cohort_scores of
+    each node's delta vs the round-start params) for the host-side
+    ReputationMonitor. The sparse round builder below supports
+    neither: it never materializes the full params stack, so there is
+    no pre-exchange hook — robustness runs use this dense builder.
     """
     aggregator = aggregator or FedAvg()
     fedavg_fast = type(aggregator) is FedAvg
+    attack_active = (
+        attack is not None
+        and malicious is not None
+        and bool(np.any(malicious))
+        and getattr(attack, "poisons_updates", False)
+    )
 
     def round_fn(fed: FederatedState, x, y, smask, n_samples, mix, adopt, trains):
         alive = fed.alive
 
         # ---- local training (every node; results masked in afterward)
+        ref_params = fed.states.params  # round-start params (delta ref)
         states, train_metrics = _train_and_select(
             fns, fed.states, alive, trains, x, y, smask, epochs
         )
+
+        # ---- adversarial injection: malicious rows poison their
+        # outgoing update before it enters ANY mix (incl. their own row,
+        # matching the socket node poisoning its learner post-fit)
+        if attack_active:
+            from p2pfl_tpu.adversary.attacks import poison_stacked
+
+            states = states.replace(
+                params=poison_stacked(
+                    states.params, ref_params, malicious, fed.round, attack
+                )
+            )
 
         # ---- weight exchange + aggregation
         # contribution gate: only alive *training* nodes inject models
@@ -309,6 +346,13 @@ def build_round_fn(
             "train_loss": train_metrics["loss"],  # [n]
             "alive": alive,
         }
+        if update_stats:
+            from p2pfl_tpu.adversary.reputation import spmd_trust_obs
+
+            # scored on the post-attack params — what each node "sent"
+            metrics["trust_obs"] = spmd_trust_obs(
+                states.params, ref_params, contrib
+            )
         return fed, metrics
 
     return round_fn

@@ -54,7 +54,8 @@ def read_statuses(directory: str | pathlib.Path) -> list[dict[str, Any]]:
     return sorted(out, key=lambda r: r.get("node", 0))
 
 
-_COLUMNS = ("node", "role", "round", "loss", "accuracy", "peers", "age")
+_COLUMNS = ("node", "role", "round", "loss", "accuracy", "trust",
+            "peers", "age")
 
 
 def _row(rec: dict[str, Any], now: float, liveness_s: float) -> dict[str, str]:
@@ -71,6 +72,9 @@ def _row(rec: dict[str, Any], now: float, liveness_s: float) -> dict[str, str]:
         "round": num("round"),
         "loss": num("loss"),
         "accuracy": num("accuracy"),
+        # reputation-weighted runs publish per-node trust (scenario.py /
+        # adversary.reputation); "-" on clean runs
+        "trust": num("trust"),
         "peers": num("peers"),
         "age": f"{age:.1f}s" + ("" if alive else " DEAD"),
     }

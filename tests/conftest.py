@@ -42,6 +42,11 @@ def pytest_configure(config):
         "unless P2PFL_SLOW_TESTS=1 (their mechanisms have faster "
         "in-suite guards; see each test's docstring)",
     )
+    config.addinivalue_line(
+        "markers",
+        "adversary: attack-injection / reputation / robustness tests "
+        "(select with -m adversary)",
+    )
 
 
 def pytest_collection_modifyitems(config, items):

@@ -1,0 +1,412 @@
+"""Adversary & trust subsystem (round 8).
+
+The load-bearing guarantee is PATH PARITY: the same AttackSpec + seed
+must poison bit-identically whether applied by the SPMD round fn
+(``poison_stacked`` on static mask rows) or by a socket node
+(``poison_update`` post-fit) — tolerance ZERO, because a robustness
+number measured on the fast SPMD path is only transferable to the
+socket deployment if the attacks are literally the same bits.
+
+The recovery tests then pin the defense end-to-end on both paths:
+undefended FedAvg collapses under 25% sign-flip while
+reputation-weighted FedAvg recovers most of the clean accuracy.
+"""
+
+import asyncio
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from p2pfl_tpu.adversary import (
+    MODEL_ATTACKS,
+    AttackSpec,
+    ReputationMonitor,
+    cohort_scores,
+    flip_labels,
+    malicious_indices,
+    poison_stacked,
+    poison_update,
+)
+
+
+def _stacked_tree(n, seed=0):
+    rng = np.random.default_rng(seed)
+    return {
+        "w": jnp.asarray(rng.normal(size=(n, 5, 3)).astype(np.float32)),
+        "b": jnp.asarray(rng.normal(size=(n, 3)).astype(np.float32)),
+        "h": jnp.asarray(rng.normal(size=(n, 4)), jnp.bfloat16),
+    }
+
+
+# --------------------------------------------------------------------
+# attack transforms
+# --------------------------------------------------------------------
+
+@pytest.mark.adversary
+@pytest.mark.parametrize("kind", MODEL_ATTACKS)
+def test_attack_parity_spmd_socket_bit_identical(kind):
+    """poison_stacked row i == poison_update on node i's tree, with
+    tolerance 0 — the parity the module docstring promises."""
+    n, rnd = 4, 3
+    spec = AttackSpec(kind=kind, scale=10.0, seed=7)
+    params = _stacked_tree(n, seed=1)
+    ref = _stacked_tree(n, seed=2)
+    malicious = np.array([False, True, False, True])
+
+    spmd = poison_stacked(params, ref, malicious, rnd, spec)
+    for i in range(n):
+        row = jax.tree.map(lambda x: x[i], params)
+        ref_i = jax.tree.map(lambda x: x[i], ref)
+        expect = (poison_update(row, ref_i, i, rnd, spec)
+                  if malicious[i] else row)
+        got = jax.tree.map(lambda x: x[i], spmd)
+        for ge, ee in zip(jax.tree.leaves(got), jax.tree.leaves(expect)):
+            assert ge.dtype == ee.dtype
+            # bitwise: compare the raw bytes, not approximate values
+            assert np.array_equal(
+                np.asarray(ge).view(np.uint8), np.asarray(ee).view(np.uint8)
+            ), f"{kind}: node {i} differs between paths"
+
+
+@pytest.mark.adversary
+def test_attack_preserves_shape_dtype_and_honest_rows():
+    n = 4
+    params = _stacked_tree(n, seed=1)
+    ref = _stacked_tree(n, seed=2)
+    malicious = np.array([True, False, False, False])
+    for kind in MODEL_ATTACKS:
+        out = poison_stacked(params, ref, malicious, 0,
+                             AttackSpec(kind=kind))
+        for po, pi in zip(jax.tree.leaves(out), jax.tree.leaves(params)):
+            assert po.shape == pi.shape and po.dtype == pi.dtype
+            # honest rows untouched
+            assert np.array_equal(np.asarray(po[1:], np.float32),
+                                  np.asarray(pi[1:], np.float32))
+
+
+@pytest.mark.adversary
+def test_signflip_reverses_delta_freerider_echoes_ref():
+    params = {"w": jnp.ones((2, 3))}
+    ref = {"w": jnp.zeros((2, 3))}
+    mal = np.array([True, True])
+    flip = poison_stacked(params, ref, mal, 0, AttackSpec(kind="signflip",
+                                                          scale=2.0))
+    np.testing.assert_allclose(np.asarray(flip["w"]), -2.0)
+    fr = poison_stacked(params, ref, mal, 0, AttackSpec(kind="freerider"))
+    np.testing.assert_allclose(np.asarray(fr["w"]), 0.0)
+
+
+@pytest.mark.adversary
+def test_noise_attack_deterministic_per_node_round():
+    p = {"w": jnp.ones((3, 3))}
+    r = {"w": jnp.zeros((3, 3))}
+    spec = AttackSpec(kind="noise", scale=1.0, seed=5)
+    a = poison_update(p, r, 1, 2, spec)
+    b = poison_update(p, r, 1, 2, spec)
+    assert np.array_equal(np.asarray(a["w"]), np.asarray(b["w"]))
+    c = poison_update(p, r, 1, 3, spec)  # different round -> new bits
+    assert not np.array_equal(np.asarray(a["w"]), np.asarray(c["w"]))
+
+
+@pytest.mark.adversary
+def test_flip_labels_involution():
+    y = np.array([0, 3, 9, 5], np.int32)
+    f = flip_labels(y, 10)
+    assert f.tolist() == [9, 6, 0, 4] and f.dtype == y.dtype
+    assert np.array_equal(flip_labels(f, 10), y)
+
+
+@pytest.mark.adversary
+def test_malicious_indices_deterministic_and_explicit():
+    a = malicious_indices(8, 0.25, seed=3)
+    assert a.sum() == 2
+    assert np.array_equal(a, malicious_indices(8, 0.25, seed=3))
+    b = malicious_indices(8, 0.0, nodes=[2, 5])
+    assert np.flatnonzero(b).tolist() == [2, 5]
+    assert malicious_indices(8, 0.0).sum() == 0
+
+
+@pytest.mark.adversary
+def test_attack_spec_rejects_unknown_kind():
+    with pytest.raises(ValueError, match="unknown attack"):
+        AttackSpec(kind="meteor")
+
+
+# --------------------------------------------------------------------
+# reputation scoring
+# --------------------------------------------------------------------
+
+def _cohort(attacker_scale=-10.0, n_honest=3, d=64, seed=0):
+    rng = np.random.default_rng(seed)
+    base = rng.normal(size=d).astype(np.float32)
+    rows = [base + 0.1 * rng.normal(size=d).astype(np.float32)
+            for _ in range(n_honest)]
+    rows.append(attacker_scale * base)
+    return np.stack(rows)
+
+
+@pytest.mark.adversary
+def test_cohort_scores_separates_attacker_np_and_jnp():
+    deltas = _cohort()
+    for xp in (np, jnp):
+        s = np.asarray(cohort_scores(xp.asarray(deltas), xp=xp))
+        assert s[:3].min() > 0.8, s
+        assert s[3] < 0.05, s
+
+
+@pytest.mark.adversary
+def test_cohort_scores_nonfinite_row_scored_zero_not_contagious():
+    deltas = _cohort()
+    deltas[1] = np.nan
+    s = np.asarray(cohort_scores(deltas, xp=np))
+    assert s[1] == 0.0
+    assert np.isfinite(s).all()
+    assert s[0] > 0.8 and s[2] > 0.8  # honest rows unharmed
+    assert s[3] < 0.05
+
+
+@pytest.mark.adversary
+def test_cohort_scores_present_mask_excludes_from_consensus():
+    deltas = _cohort()
+    present = np.array([True, True, True, False])
+    s = np.asarray(cohort_scores(deltas, present=present, xp=np))
+    assert s[3] == 0.0 and s[:3].min() > 0.8
+
+
+@pytest.mark.adversary
+def test_reputation_first_observation_replaces_prior():
+    mon = ReputationMonitor(3, alpha=0.5, cutoff=0.15)
+    mon.observe(np.array([0.8, 0.02, 0.6]))
+    # NOT blended with the initial 1.0 — an attacker scoring ~0 in
+    # round 0 must be excludable immediately
+    np.testing.assert_allclose(mon.trust, [0.8, 0.02, 0.6], atol=1e-6)
+    mon.observe(np.array([0.8, 0.02, 0.6]))  # now EWMA
+    np.testing.assert_allclose(mon.trust, [0.8, 0.02, 0.6], atol=1e-6)
+    mon.observe(np.array([0.0, 0.8, 0.6]))
+    np.testing.assert_allclose(mon.trust, [0.4, 0.41, 0.6], atol=1e-6)
+    assert mon.suspects() == []
+    w = mon.weights_vector()
+    assert (w > 0).all()
+
+
+@pytest.mark.adversary
+def test_reputation_cutoff_zeroes_and_mask_preserves_trust():
+    mon = ReputationMonitor(3, alpha=1.0, cutoff=0.5)
+    mon.observe(np.array([0.9, 0.1, 0.7]))
+    assert mon.suspects() == [1]
+    np.testing.assert_allclose(mon.weights_vector(), [0.9, 0.0, 0.7])
+    # unobserved nodes keep their trust (silence is not evidence)
+    mon.observe(np.array([0.2, 0.2, 0.2]), mask=np.array([True, False, False]))
+    np.testing.assert_allclose(mon.trust, [0.2, 0.1, 0.7], atol=1e-6)
+    assert len(mon.history) == 2
+
+
+@pytest.mark.adversary
+def test_observe_entries_attributes_partials_to_contributors():
+    mon = ReputationMonitor(4, alpha=1.0, cutoff=0.15)
+    d = 32
+    rng = np.random.default_rng(1)
+    base = rng.normal(size=d).astype(np.float32)
+    ref = {"w": np.zeros(d, np.float32)}
+    entries = [
+        (frozenset({0}), {"w": base}),
+        (frozenset({1}), {"w": base + 0.05}),
+        (frozenset({2, 3}), {"w": -10.0 * base}),  # merged partial
+    ]
+    mon.observe_entries(ref, entries)
+    assert mon.trust[0] > 0.8 and mon.trust[1] > 0.8
+    # both contributors of the anomalous partial take the hit
+    assert mon.trust[2] < 0.05 and mon.trust[3] < 0.05
+    scales = mon.entry_scales([frozenset({0}), frozenset({0, 2}),
+                              frozenset(), frozenset({9})])
+    assert scales[0] == pytest.approx(mon.weights_vector()[0])
+    assert scales[1] == pytest.approx(mon.weights_vector()[[0, 2]].mean())
+    assert scales[2] == 1.0 and scales[3] == 1.0  # no evidence, no penalty
+
+
+# --------------------------------------------------------------------
+# session weight parity (satellite: one shared effective-weights path)
+# --------------------------------------------------------------------
+
+def _tiny_tree(v):
+    return {"w": np.full((4, 2), v, np.float32),
+            "b": np.full((2,), v, np.float32)}
+
+
+@pytest.mark.adversary
+def test_session_numpy_fast_path_matches_device_under_unequal_weights():
+    """The FedAvg numpy fast path and the tree_stack device path must
+    agree on NON-uniform weights — the regression the shared
+    effective-weights computation prevents."""
+    from p2pfl_tpu.core.aggregators import FedAvg
+    from p2pfl_tpu.p2p.session import AggregationSession
+
+    class _DeviceFedAvg(FedAvg):
+        """Same math; fails the fast path's ``type(...) is FedAvg``
+        check, so it exercises the tree_stack device branch."""
+
+    entries = [(_tiny_tree(1.0), 10.0), (_tiny_tree(2.0), 30.0),
+               (_tiny_tree(4.0), 60.0)]
+    fast = AggregationSession(FedAvg())._aggregate(entries)[0]
+    dev = AggregationSession(_DeviceFedAvg())._aggregate(entries)[0]
+    expect = (1.0 * 0.1 + 2.0 * 0.3 + 4.0 * 0.6)
+    for leaf in jax.tree.leaves(fast):
+        np.testing.assert_allclose(np.asarray(leaf), expect, rtol=1e-6)
+    for f, d in zip(jax.tree.leaves(fast), jax.tree.leaves(dev)):
+        np.testing.assert_allclose(np.asarray(f), np.asarray(d), rtol=1e-5)
+
+
+@pytest.mark.adversary
+def test_session_finish_scales_weights_by_contributor_trust():
+    from p2pfl_tpu.core.aggregators import FedAvg
+    from p2pfl_tpu.p2p.session import AggregationSession
+
+    async def run():
+        mon = ReputationMonitor(3, alpha=1.0, cutoff=0.5)
+        mon.observe(np.array([1.0, 1.0, 0.1]))  # node 2 below cutoff
+        sess = AggregationSession(FedAvg(), reputation=mon)
+        sess.set_nodes_to_aggregate([0, 1, 2])
+        sess.set_reference(_tiny_tree(0.0))
+        sess.add_model(_tiny_tree(1.0), [0], 1.0)
+        sess.add_model(_tiny_tree(1.0), [1], 1.0)
+        sess.add_model(_tiny_tree(100.0), [2], 1.0)
+        assert sess.done.is_set()
+        return sess.result[0]
+
+    agg = asyncio.run(run())
+    # the zero-trust node's 100.0 tree must not contaminate the mean
+    for leaf in jax.tree.leaves(agg):
+        np.testing.assert_allclose(np.asarray(leaf), 1.0, atol=1e-5)
+
+
+# --------------------------------------------------------------------
+# end-to-end recovery, SPMD path (8 virtual devices)
+# --------------------------------------------------------------------
+
+def _spmd_cfg(adversary=None, rounds=8):
+    from p2pfl_tpu.config.schema import ScenarioConfig
+
+    d = {
+        "name": "adv", "n_nodes": 8, "topology": "fully",
+        "data": {"dataset": "mnist", "batch_size": 16,
+                 "samples_per_node": 64},
+        "model": {"model": "mlp"},
+        "training": {"rounds": rounds, "eval_every": 0},
+    }
+    if adversary:
+        d["adversary"] = adversary
+    return ScenarioConfig.from_dict(d)
+
+
+@pytest.mark.adversary
+def test_spmd_reputation_recovers_from_signflip(n_devices):
+    """25% sign-flip destroys undefended FedAvg; reputation-weighted
+    FedAvg recovers most of the clean accuracy, and the final trust
+    state separates the malicious cohort."""
+    from p2pfl_tpu.federation.scenario import Scenario
+
+    atk = {"fraction": 0.25, "kind": "signflip"}
+    res_atk = Scenario(_spmd_cfg(atk)).run()
+    sc = Scenario(_spmd_cfg({**atk, "reputation": True}))
+    res_rep = sc.run()
+
+    assert res_atk.final_accuracy < 0.5  # attack actually bites
+    assert res_rep.final_accuracy > res_atk.final_accuracy + 0.3
+    assert res_rep.final_accuracy > 0.8
+    mal = np.flatnonzero(sc.malicious)
+    honest = np.flatnonzero(~sc.malicious)
+    trust = sc.reputation.trust
+    assert trust[mal].max() < trust[honest].min()
+    assert set(mal.tolist()) <= set(sc.reputation.suspects())
+
+
+@pytest.mark.adversary
+def test_spmd_labelflip_runs_and_degrades(n_devices):
+    from p2pfl_tpu.federation.scenario import Scenario
+
+    res_clean = Scenario(_spmd_cfg(rounds=4)).run()
+    res_flip = Scenario(_spmd_cfg(
+        {"fraction": 0.5, "kind": "labelflip"}, rounds=4)).run()
+    # data poisoning at 50% measurably hurts but must not crash
+    assert res_flip.final_accuracy < res_clean.final_accuracy
+
+
+@pytest.mark.adversary
+def test_sparse_round_builder_refuses_poisoning(n_devices):
+    """The ppermute sparse round builder has no poisoning hook — the
+    scenario must refuse (fail loud) rather than silently simulate a
+    clean federation when sparse exchange is forced on."""
+    from p2pfl_tpu.federation.scenario import Scenario
+
+    cfg = _spmd_cfg({"fraction": 0.25, "kind": "signflip"}, rounds=2)
+    cfg.transport = "sparse"
+    with pytest.raises(ValueError, match="sparse"):
+        Scenario(cfg)
+
+
+# --------------------------------------------------------------------
+# end-to-end recovery, socket path (4 nodes, in-process asyncio)
+# --------------------------------------------------------------------
+
+@pytest.mark.adversary
+def test_socket_reputation_recovery_4node():
+    """ISSUE 4 acceptance: a 4-node socket federation with one
+    sign-flipper — undefended FedAvg collapses, per-node local
+    reputation recovers, and every honest node's monitor ranks the
+    attacker lowest."""
+    from p2pfl_tpu.config.schema import ScenarioConfig
+    from p2pfl_tpu.p2p.launch import run_simulation
+
+    def cfg(reputation):
+        return ScenarioConfig.from_dict({
+            "name": "sockadv", "n_nodes": 4, "topology": "fully",
+            "data": {"dataset": "mnist", "batch_size": 16,
+                     "samples_per_node": 64},
+            "model": {"model": "mlp"},
+            "training": {"rounds": 6, "eval_every": 0},
+            "adversary": {"nodes": [2], "kind": "signflip",
+                          "reputation": reputation},
+        })
+
+    out_atk = run_simulation(cfg(False), timeout=240)
+    out_rep = run_simulation(cfg(True), timeout=240)
+    assert out_atk["mean_accuracy"] < 0.5
+    assert out_rep["mean_accuracy"] > out_atk["mean_accuracy"] + 0.25
+    assert 2 in out_rep["suspects"]
+    for i, trust in enumerate(out_rep["trust"]):
+        if i == 2 or trust is None:
+            continue
+        t = np.asarray(trust)
+        assert t[2] == t.min(), (i, trust)  # attacker ranked lowest
+
+
+# --------------------------------------------------------------------
+# Krum small-cohort guards (satellite: fail loud, not fake-robust)
+# --------------------------------------------------------------------
+
+@pytest.mark.adversary
+def test_krum_raises_when_rows_below_f_plus_3():
+    from p2pfl_tpu.core.aggregators import Krum
+    from p2pfl_tpu.core.pytree import tree_stack
+
+    st = tree_stack([_tiny_tree(float(i)) for i in range(4)])
+    with pytest.raises(ValueError, match="f\\+3"):
+        Krum(f=2)(st, jnp.ones(4))
+
+
+@pytest.mark.adversary
+def test_krum_warns_once_when_present_below_f_plus_3():
+    from p2pfl_tpu.core.aggregators import Krum
+    from p2pfl_tpu.core.pytree import tree_stack
+
+    st = tree_stack([_tiny_tree(float(i)) for i in range(5)])
+    mask = jnp.array([True, True, True, False, False])  # 3 < f+3=4
+    agg = Krum(f=1, m=1)
+    with pytest.warns(RuntimeWarning, match="NOT Byzantine-robust"):
+        agg(st, jnp.ones(5), mask=mask)
+    with warnings.catch_warnings():  # second call: warned once only
+        warnings.simplefilter("error")
+        agg(st, jnp.ones(5), mask=mask)

@@ -29,7 +29,33 @@ def test_zero_budget_still_emits_parseable_json():
     # with zero budget (t_end == t_start, remaining negative
     # everywhere), every phase is explicitly accounted as skipped
     assert set(out["skipped_phases"]) == {
-        "headline", "cifar16", "cpu8", "socket24", "socket_mp", "vit32"
+        "headline", "cifar16", "cpu8", "socket24", "socket_mp",
+        "robust", "vit32"
+    }
+
+
+def test_robust_phase_dry_run_emits_variant_plan():
+    """P2PFL_ROBUST_DRY=1: the robust phase must emit its variant plan
+    as one parseable part without touching any accelerator — the cheap
+    orchestration smoke for the round-8 robustness phase."""
+    env = dict(os.environ, P2PFL_ROBUST_DRY="1")
+    code = (f"import sys; sys.path.insert(0, {str(REPO)!r})\n"
+            "import bench; bench._phase_robust()\n")
+    res = subprocess.run([sys.executable, "-c", code],
+                         capture_output=True, text=True, timeout=60,
+                         env=env, cwd=REPO)
+    assert res.returncode == 0, res.stderr[-500:]
+    sys.path.insert(0, str(REPO))
+    import bench
+
+    parts = [json.loads(line[len(bench._PART_TAG):])
+             for line in res.stdout.splitlines()
+             if line.startswith(bench._PART_TAG)]
+    assert len(parts) == 1 and parts[0]["robust_dry"] is True
+    assert set(parts[0]["robust_variants"]) == {
+        "robust_acc_clean_fedavg", "robust_acc_signflip_fedavg",
+        "robust_acc_signflip_krum", "robust_acc_signflip_trimmedmean",
+        "robust_acc_signflip_repfedavg",
     }
 
 

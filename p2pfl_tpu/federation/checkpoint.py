@@ -90,7 +90,13 @@ def load_checkpoint(path: str | pathlib.Path, template: FederatedState) -> Feder
     flat_r = jax.tree.leaves(restored)
     conformed = []
     for t, r in zip(flat_t, flat_r):
-        r = jnp.asarray(r)
+        # copy=True: msgpack_restore leaves are non-owning views of the
+        # blob bytes, and jnp.asarray/device_put zero-copy numpy on CPU —
+        # a resumed FederatedState must OWN its buffers because the
+        # round fn donates them (transport.compile_round
+        # donate_argnums=(0,)); donating externally-backed memory reads
+        # back stale or freed data once the source is collected.
+        r = jnp.array(r, copy=True)
         if r.shape != t.shape:
             raise ValueError(
                 f"checkpoint leaf shape {r.shape} != expected {t.shape}"

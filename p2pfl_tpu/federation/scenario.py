@@ -54,6 +54,7 @@ from p2pfl_tpu.parallel.federated import (
     init_federation,
     make_round_plan,
 )
+from p2pfl_tpu.obs import trace as obs_trace
 from p2pfl_tpu.parallel.transport import MeshTransport, edge_offsets
 from p2pfl_tpu.topology.topology import generate_topology
 from p2pfl_tpu.utils.metrics import MetricsLogger
@@ -425,6 +426,11 @@ class Scenario(Observable):
             return
         status_dir = self.logger.dir / "status"
         n_alive = int(alive.sum())
+        times = sorted(getattr(self, "round_times_s", []))
+        p95 = (
+            round(times[min(len(times) - 1, int(0.95 * len(times)))], 4)
+            if times else None
+        )
         for i in range(self.config.n_nodes):
             if not alive[i]:
                 continue  # dead nodes go silent, like a crashed process
@@ -433,6 +439,7 @@ class Scenario(Observable):
                 {
                     "role": self.roles[i],
                     "round": r + 1,
+                    "round_p95_s": p95,
                     "loss": float(train_loss[i]),
                     "accuracy": (
                         float(ev["per_node_accuracy"][i]) if ev else None
@@ -463,7 +470,17 @@ class Scenario(Observable):
             target_accuracy: float | None = None) -> ScenarioResult:
         cfg = self.config
         rounds = rounds if rounds is not None else cfg.training.rounds
+        # obs: recompile counter + span tracer (P2PFL_TRACE env gate).
+        # The listener is idempotent and the tracer a no-op when off;
+        # a mid-run recompile storm (perf.md §7b) shows up as
+        # xla/backend_compiles > 0 over the steady-state rounds.
+        obs_trace.install_xla_listener()
+        tracer = obs_trace.configure_from_env(
+            default_dir=(self.logger.dir / "trace")
+            if self.logger.dir else None,
+        )
         round_times: list[float] = []
+        self.round_times_s = round_times  # _publish_statuses reads p95
         rounds_to_target = None
         ev = None
         ev_round = -1  # round the last evaluation reflects
@@ -489,11 +506,12 @@ class Scenario(Observable):
                     alive=self.transport.put_stacked(jnp.asarray(alive))
                 )
                 trains_vote = self._voted_trains(alive, r)
-                self.fed, metrics = self._round_fn(
-                    self.fed, *self._data_args,
-                    *self._plan_args(trains_vote),
-                )
-                jax.block_until_ready(self.fed.states.params)
+                with tracer.span("scenario.round", args={"round": r}):
+                    self.fed, metrics = self._round_fn(
+                        self.fed, *self._data_args,
+                        *self._plan_args(trains_vote),
+                    )
+                    jax.block_until_ready(self.fed.states.params)
                 if tracing:
                     jax.profiler.stop_trace()
                     tracing = False
@@ -559,6 +577,8 @@ class Scenario(Observable):
         finally:
             if tracing:  # exception mid-profiled-round
                 jax.profiler.stop_trace()
+            if tracer.enabled and self._proc0:
+                tracer.export(process_name=f"scenario[{cfg.name}]")
 
         last_round = start_round + rounds - 1
         if ev is None or ev_round != last_round:  # don't report stale eval

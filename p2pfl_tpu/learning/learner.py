@@ -37,6 +37,7 @@ from p2pfl_tpu.learning.objectives import (
     masked_accuracy,
     ocsvm_penalty,
 )
+from p2pfl_tpu.obs.trace import get_tracer
 
 
 class TrainState(struct.PyTreeNode):
@@ -453,6 +454,12 @@ class JaxLearner(NodeLearner):
         if self._interrupted:  # honor a pending interrupt_fit()
             self._interrupted = False
             return
+        with get_tracer().span("learner.fit",
+                               args={"round": self.round,
+                                     "epochs": self.epochs}):
+            self._fit_traced()
+
+    def _fit_traced(self) -> None:
         x, y, mask = self._fit_args()
         t0 = time.monotonic()
         if self.epochs == 1:
@@ -521,9 +528,11 @@ class JaxLearner(NodeLearner):
         self._interrupted = True
 
     def evaluate(self):
-        x, y, mask = self._eval_args()
-        metrics = self._eval_jit(self.state.params, x, y, mask)
-        out = {k: float(v) for k, v in metrics.items()}
+        with get_tracer().span("learner.evaluate",
+                               args={"round": self.round}):
+            x, y, mask = self._eval_args()
+            metrics = self._eval_jit(self.state.params, x, y, mask)
+            out = {k: float(v) for k, v in metrics.items()}
         if self.logger is not None:
             self.logger.log_metrics(
                 {f"Val/{k}": v for k, v in out.items()},

@@ -1,0 +1,24 @@
+"""One timestamped record shape for every outward-flowing row.
+
+Before round 9 the repo had two ad-hoc conventions: metrics rows were
+``{"ts", "step", "round", "node", ...}`` (utils/metrics.py) and status
+files were ``{"node", "ts", ...}`` (utils/monitor.py). Both now stamp
+through this helper, and the tracer's summaries use it too — one
+``ts`` meaning (epoch seconds, float, stamped at emission) everywhere,
+so a merge across streams never has to guess which clock a row used.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any
+
+
+def make_record(node: int | None, **fields: Any) -> dict[str, Any]:
+    """Canonical emission record: ``node`` (None = federation-level),
+    ``ts`` (epoch seconds at emission), then the caller's fields. A
+    caller-supplied ``ts`` in ``fields`` wins — replayed/merged rows
+    keep their original stamp."""
+    rec: dict[str, Any] = {"node": node, "ts": time.time()}
+    rec.update(fields)
+    return rec

@@ -31,8 +31,21 @@ from p2pfl_tpu.core.aggregators import get_aggregator
 from p2pfl_tpu.datasets import FederatedDataset
 from p2pfl_tpu.learning import JaxLearner
 from p2pfl_tpu.models.base import build_model
+from p2pfl_tpu.obs import trace as obs_trace
 from p2pfl_tpu.p2p.node import P2PNode
 from p2pfl_tpu.topology.topology import generate_topology
+
+
+def _trace_setup(cfg: ScenarioConfig) -> obs_trace.Tracer:
+    """Per-process obs wiring: the recompile listener plus the tracer,
+    enabled by P2PFL_TRACE and exporting into ``<log_dir>/<name>/trace``
+    — the same directory convention as the status dir, so traceview
+    finds every process of a federation under one root."""
+    obs_trace.install_xla_listener()
+    return obs_trace.configure_from_env(
+        default_dir=(pathlib.Path(cfg.log_dir) / cfg.name / "trace")
+        if cfg.log_dir else None,
+    )
 
 
 def _adversary_setup(cfg: ScenarioConfig):
@@ -199,7 +212,10 @@ async def _run_node(cfg: ScenarioConfig, idx: int, ports: list[int],
                     status_dir, idx,
                     {"role": node.role, "round": node.round,
                      "peers": len(node.peers),
-                     "leader": node.leader},
+                     "leader": node.leader,
+                     "round_p95_s": node.round_p95_s(),
+                     "bytes_in": node.bytes_in,
+                     "bytes_out": node.bytes_out},
                 )
                 await asyncio.sleep(cfg.protocol.heartbeat_period_s)
 
@@ -226,10 +242,16 @@ async def _run_node(cfg: ScenarioConfig, idx: int, ports: list[int],
         publish_status(
             status_dir, idx,
             {"role": node.role, "round": node.round,
-             "peers": len(node.peers), "leader": node.leader, **metrics},
+             "peers": len(node.peers), "leader": node.leader,
+             "round_p95_s": node.round_p95_s(),
+             "bytes_in": node.bytes_in,
+             "bytes_out": node.bytes_out, **metrics},
         )
     await node.stop()
-    result = {"node": idx, "round": node.round, **metrics}
+    result = {"node": idx, "round": node.round,
+              "round_p95_s": node.round_p95_s(),
+              "bytes_in": node.bytes_in, "bytes_out": node.bytes_out,
+              **metrics}
     # round-loop wall clock (post-warm-up, excludes startup/diffusion):
     # what socket_round_s_24node_multiproc is computed from
     if node.learn_t0 is not None and node.learn_t1 is not None:
@@ -248,6 +270,7 @@ def node_main(config_path: str, idx: int | list[int], ports: list[int],
     and one-process-per-node."""
     idxs = [idx] if isinstance(idx, int) else list(idx)
     cfg = ScenarioConfig.load(config_path)
+    tracer = _trace_setup(cfg)
     if cfg.log_dir:
         # per-participant log trail + environment banner
         # (base_node.py:133-158, utils/env.py parity)
@@ -265,12 +288,20 @@ def node_main(config_path: str, idx: int | list[int], ports: list[int],
             )
         )
 
-    for result in asyncio.run(_run_all()):
+    results = asyncio.run(_run_all())
+    if tracer.enabled:
+        # one file per OS process; nodes sharing this event loop are
+        # separated by lane inside it (traceview merges across files)
+        tracer.export(
+            process_name="nodes " + ",".join(map(str, idxs))
+        )
+    for result in results:
         print("P2PFL_RESULT " + json.dumps(result), flush=True)
 
 
 async def _simulate(cfg: ScenarioConfig, timeout: float = 600) -> dict:
     n = cfg.n_nodes
+    tracer = _trace_setup(cfg)
     data = FederatedDataset.make(cfg.data, n)
     topo = generate_topology(cfg.topology, n, **cfg.topology_kwargs)
     from p2pfl_tpu.learning.learner import SharedTrainer
@@ -324,6 +355,10 @@ async def _simulate(cfg: ScenarioConfig, timeout: float = 600) -> dict:
     # 1 and skew the steady-state round time being measured
     for node in nodes:
         node.learner.warm_up()
+    # steady-state recompile accounting starts HERE: warm-up compiles
+    # are expected; anything counted past this point is a mid-round
+    # recompile (the round-7 storm this counter exists to surface)
+    obs_trace.reset_xla_counters()
     t0 = time.monotonic()
     nodes[starter].set_start_learning(
         cfg.training.rounds, cfg.training.epochs_per_round
@@ -350,7 +385,15 @@ async def _simulate(cfg: ScenarioConfig, timeout: float = 600) -> dict:
         "mean_accuracy": (
             round(sum(accs) / len(accs), 4) if accs else None
         ),
+        # post-warm-up recompiles (0 on a healthy run — see the reset
+        # above) and the federation's total wire traffic
+        "xla_recompiles": obs_trace.xla_recompiles(),
+        "bytes_in": sum(nd.bytes_in for nd in nodes),
+        "bytes_out": sum(nd.bytes_out for nd in nodes),
     }
+    if tracer.enabled:
+        out["obs"] = tracer.summarize()
+        tracer.export(process_name=f"sim[{cfg.name}]")
     if any(nd.reputation is not None for nd in nodes):
         # each node's LOCAL trust vector (decentralized: no shared
         # monitor) + who it would exclude — the robustness tests and

@@ -38,6 +38,7 @@ from p2pfl_tpu.config.schema import ProtocolConfig
 from p2pfl_tpu.core.aggregators import Aggregator
 from p2pfl_tpu.core.serialize import decode_parameters, encode_parameters
 from p2pfl_tpu.federation.membership import Membership
+from p2pfl_tpu.obs.trace import get_tracer
 from p2pfl_tpu.p2p.protocol import (
     GOSSIPED,
     PERIODIC_FLOODS,
@@ -173,9 +174,22 @@ class P2PNode:
         # so finish-time aggregation is trust-weighted
         self.attack = attack
         self.reputation = reputation
+        # obs wiring: the process tracer (configured in place, so the
+        # cached reference stays valid across enable/disable) + always-
+        # counted wire totals. The plain ints cost two adds per frame
+        # regardless of tracing; per-peer/per-type counter keys are
+        # built only behind tracer.enabled (f-strings per frame are
+        # exactly the allocation the disabled path must not pay).
+        self._tracer = get_tracer()
+        self._lane = f"node{idx}"
+        self.bytes_in = 0
+        self.bytes_out = 0
+        # per-round wall clocks (appended by _learning_loop) — the p95
+        # the status publisher reports comes from here
+        self.round_wall_s: list[float] = []
         self.session = AggregationSession(
             aggregator, timeout_s=self.protocol.aggregation_timeout_s,
-            reputation=reputation,
+            reputation=reputation, lane=self._lane,
         )
         self.membership = Membership(n_nodes, self.protocol, virtual=False)
         self.peers: dict[int, PeerState] = {}
@@ -444,6 +458,7 @@ class P2PNode:
                     peer.draining = True
                     try:
                         await write_message(peer.writer, msg)
+                        self._count_tx(peer, msg)
                     except (ConnectionError, RuntimeError, OSError):
                         dead = True
                         self._drop_conn(peer)
@@ -453,10 +468,26 @@ class P2PNode:
                 with contextlib.suppress(ValueError):
                     peer.send_q.task_done()
 
+    def _count_rx(self, peer: PeerState, msg: Message) -> None:
+        self.bytes_in += msg._wire_bytes
+        tr = self._tracer
+        if tr.enabled:
+            tr.count(f"rx_bytes/peer{peer.idx}", msg._wire_bytes)
+            tr.count(f"rx_msgs/{msg.type.value}")
+
+    def _count_tx(self, peer: PeerState, msg: Message) -> None:
+        n = msg.wire_size()
+        self.bytes_out += n
+        tr = self._tracer
+        if tr.enabled:
+            tr.count(f"tx_bytes/peer{peer.idx}", n)
+            tr.count(f"tx_msgs/{msg.type.value}")
+
     async def _read_loop(self, peer: PeerState, reader) -> None:
         try:
             while True:
                 msg = await read_message(reader)
+                self._count_rx(peer, msg)
                 await self._dispatch(peer, msg)
         except (asyncio.IncompleteReadError, ConnectionError, ValueError):
             self._drop_conn(peer)
@@ -653,10 +684,17 @@ class P2PNode:
         claimed sender (always true on plaintext federations)."""
         if self._verifier is None:
             return True
-        if self._verifier.verify(
-            msg.cert, msg.sig, msg.signing_bytes(), msg.sender
-        ):
+        tr = self._tracer
+        with tr.span("p2p.verify", lane=self._lane):
+            ok = self._verifier.verify(
+                msg.cert, msg.sig, msg.signing_bytes(), msg.sender
+            )
+        if ok:
+            if tr.enabled:
+                tr.count("verify_ok")
             return True
+        if tr.enabled:
+            tr.count("verify_fail")
         log.warning(
             "node %d dropping %s with unverifiable origin claim sender=%d",
             self.idx, msg.type.value, msg.sender,
@@ -691,6 +729,8 @@ class P2PNode:
             peer.writer.writelines(msg.wire_segments())
         except (ConnectionError, RuntimeError, OSError):
             self._drop_conn(peer)
+        else:
+            self._count_tx(peer, msg)
         return True
 
     async def _write(self, peer: PeerState, msg: Message) -> None:
@@ -706,6 +746,14 @@ class P2PNode:
         elif self._try_fast_write(peer, msg):
             return
         elif peer.send_q is not None and self.peers.get(peer.idx) is peer:
+            tr = self._tracer
+            if tr.enabled:
+                # queue depth AT enqueue (incl. this frame): the lane's
+                # congestion high-water mark — a depth pinned at the
+                # bound means the bounded queue, not the socket, paces
+                # this peer's egress
+                tr.high_water(f"send_q_depth/peer{peer.idx}",
+                              peer.send_q.qsize() + 1)
             await peer.send_q.put(msg)
         else:
             # pre-registration writes (none today) fall through direct
@@ -967,7 +1015,11 @@ class P2PNode:
                 await asyncio.sleep(self.gossip_period_s)
         self.learn_t0 = time.monotonic()
         while self.round < self.total_rounds:
-            await self._train_round()
+            t0 = time.monotonic()
+            with self._tracer.span("node.round", lane=self._lane,
+                                   args={"round": self.round}):
+                await self._train_round()
+            self.round_wall_s.append(time.monotonic() - t0)
         self.learn_t1 = time.monotonic()
         # final evaluation, shared with the federation (the metrics
         # flood the reference stubbed out, node.py:611-620 + 875-878)
@@ -1024,9 +1076,21 @@ class P2PNode:
         """Local training off the event loop: a blocking device call in
         line would starve heartbeats/gossip for the whole epoch and get
         peers evicted by membership timeouts."""
-        await asyncio.get_running_loop().run_in_executor(
-            None, self.learner.fit
-        )
+        with self._tracer.span("node.fit", lane=self._lane,
+                               args={"round": self.round}):
+            await asyncio.get_running_loop().run_in_executor(
+                None, self.learner.fit
+            )
+
+    def round_p95_s(self) -> float | None:
+        """p95 of completed round wall times (None before the first
+        round finishes) — the tail statistic the status publisher and
+        monitor columns report; a mean would hide the one straggler
+        round a stalled peer causes."""
+        if not self.round_wall_s:
+            return None
+        xs = sorted(self.round_wall_s)
+        return xs[min(len(xs) - 1, int(0.95 * len(xs)))]
 
     def _poisons_updates(self) -> bool:
         return self.attack is not None and self.attack.poisons_updates

@@ -143,6 +143,12 @@ class Message:
     # the header's copy), and every relay/re-encode reuses it.
     _payload_digest: bytes | None = dataclasses.field(
         default=None, repr=False, compare=False)
+    # total frame size as it crossed the wire (preamble + header +
+    # payload), stamped by read_message — what the receive-side byte
+    # counters (obs tracing, node.bytes_in) account, so rx and tx
+    # totals are comparable without re-encoding
+    _wire_bytes: int = dataclasses.field(
+        default=0, repr=False, compare=False)
 
     def __post_init__(self):
         if not self.msg_id and self.type in GOSSIPED:
@@ -221,6 +227,13 @@ class Message:
         the socket send path uses ``wire_segments()`` so the payload is
         not copied into a contiguous frame."""
         return b"".join(self.wire_segments())
+
+    def wire_size(self) -> int:
+        """Bytes this frame occupies on the wire. Free after a send
+        (the header memo already exists); builds the memo otherwise."""
+        if self._head is None:
+            self.wire_segments()
+        return len(self._head) + len(self.payload)
 
     @staticmethod
     def _from_header(obj: dict, payload: bytes) -> "Message":
@@ -301,7 +314,9 @@ async def read_message(reader: asyncio.StreamReader) -> Message:
     # socket read itself. The returned bytes object is handed to
     # serialize.unpack without further slicing.
     payload = await reader.readexactly(pl) if pl else b""
-    return Message._from_header(obj, payload)
+    msg = Message._from_header(obj, payload)
+    msg._wire_bytes = len(pre) + hlen + pl
+    return msg
 
 
 class DedupRing:

@@ -31,6 +31,7 @@ import numpy as np
 
 from p2pfl_tpu.core.aggregators import Aggregator, FedAvg
 from p2pfl_tpu.core.pytree import tree_stack
+from p2pfl_tpu.obs.trace import get_tracer
 
 Params = Any
 
@@ -39,9 +40,14 @@ class AggregationSession:
     """One round's aggregation state for one node."""
 
     def __init__(self, aggregator: Aggregator | None = None,
-                 timeout_s: float = 60.0, reputation=None):
+                 timeout_s: float = 60.0, reputation=None,
+                 lane: str | None = None):
         self.aggregator = aggregator or FedAvg()
         self.timeout_s = timeout_s  # AGGREGATION_TIMEOUT
+        # obs: the owning node's trace lane (k nodes share a process
+        # tracer in packed launch layouts — the lane attributes spans)
+        self._tracer = get_tracer()
+        self._lane = lane
         #: optional adversary.ReputationMonitor shared across rounds:
         #: scores this session's entries at finish time and rescales
         #: their weights by contributor trust (see _finish/_aggregate)
@@ -93,6 +99,11 @@ class AggregationSession:
     def add_model(self, params: Params, contributors, weight: float) -> tuple[int, ...]:
         """Returns the contributors now covered (broadcast as
         MODELS_AGGREGATED, node.py:363-369). Empty tuple = rejected."""
+        with self._tracer.span("session.add_model", lane=self._lane):
+            return self._add_model(params, contributors, weight)
+
+    def _add_model(self, params: Params, contributors,
+                   weight: float) -> tuple[int, ...]:
         contrib = frozenset(int(i) for i in contributors)
         if not contrib:
             return ()
@@ -199,14 +210,30 @@ class AggregationSession:
         if keys is not None and self.reputation is not None:
             weights = weights * self.reputation.entry_scales(keys)
         if type(self.aggregator) is FedAvg:
-            # Host fast path. Models in the socket session are host
-            # arrays on both sides (deserialized on arrival, re-encoded
-            # on send), and the entry count varies with gossip timing —
-            # pushing every combination through jnp.stack + eager XLA
-            # reductions compiles a fresh program per distinct stack
-            # size mid-round (measured: ~450 compiles / 2 rounds on the
-            # 24-node uncapped bench, ~30% of wall). A numpy weighted
-            # mean is shape-oblivious and stays off-device.
+            return self._aggregate_numpy(entries, weights)
+        with self._tracer.span(
+            "session.aggregate", lane=self._lane,
+            args={"path": "stacked_device", "n": len(entries)},
+        ):
+            stacked = tree_stack(
+                [jax.tree.map(np.asarray, p) for p, _ in entries]
+            )
+            agg = self.aggregator(stacked, weights)
+            return jax.tree.map(np.asarray, agg), (), float(weights.sum())
+
+    def _aggregate_numpy(self, entries, weights):
+        # Host fast path. Models in the socket session are host
+        # arrays on both sides (deserialized on arrival, re-encoded
+        # on send), and the entry count varies with gossip timing —
+        # pushing every combination through jnp.stack + eager XLA
+        # reductions compiles a fresh program per distinct stack
+        # size mid-round (measured: ~450 compiles / 2 rounds on the
+        # 24-node uncapped bench, ~30% of wall). A numpy weighted
+        # mean is shape-oblivious and stays off-device.
+        with self._tracer.span(
+            "session.aggregate", lane=self._lane,
+            args={"path": "numpy_fast", "n": len(entries)},
+        ):
             total = float(weights.sum())
             if total > 0:
                 wn = weights / total
@@ -222,9 +249,6 @@ class AggregationSession:
                 return acc.astype(np.asarray(xs[0]).dtype)
 
             return jax.tree.map(leaf, *trees), (), total
-        stacked = tree_stack([jax.tree.map(np.asarray, p) for p, _ in entries])
-        agg = self.aggregator(stacked, weights)
-        return jax.tree.map(np.asarray, agg), (), float(weights.sum())
 
     def clear(self) -> None:
         """Reset for the next round (aggregator.py:231-238)."""

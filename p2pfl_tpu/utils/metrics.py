@@ -18,8 +18,9 @@ from __future__ import annotations
 import csv
 import json
 import pathlib
-import time
 from typing import Any
+
+from p2pfl_tpu.obs.records import make_record
 
 
 class MetricsLogger:
@@ -61,13 +62,13 @@ class MetricsLogger:
 
     def log_metrics(self, metrics: dict[str, float], step: int = 0,
                     round: int = 0, node: int | None = None) -> None:
-        rec = {
-            "ts": time.time(),
-            "step": int(step),
-            "round": int(round),
-            "node": node,
+        # the shared obs record shape (obs.records.make_record): one ts
+        # convention across metrics rows, status files, and trace
+        # summaries
+        rec = make_record(
+            node, step=int(step), round=int(round),
             **{k: float(v) for k, v in metrics.items()},
-        }
+        )
         self.history.append(rec)
         if self._wandb_run is not None:
             # remote tracking is independent of the local log_dir —

@@ -22,18 +22,37 @@ import json
 import os
 import pathlib
 import sys
+import threading
 import time
 from typing import Any
 
+from p2pfl_tpu.obs.records import make_record
+
 DEFAULT_LIVENESS_S = 20.0  # webserver/app.py:307-311 cutoff
+
+# per-(directory, node) monotonic publish sequence: ``ts`` comes from
+# each host's wall clock, and cross-host skew can make a stale node
+# look fresher than a live one — ``seq`` only ever grows per publisher,
+# so readers can order one node's records skew-free
+_seq_lock = threading.Lock()
+_seq: dict[tuple[str, int], int] = {}
+
+
+def _next_seq(directory: pathlib.Path, node: int) -> int:
+    key = (str(directory), int(node))
+    with _seq_lock:
+        _seq[key] = _seq.get(key, 0) + 1
+        return _seq[key]
 
 
 def publish_status(directory: str | pathlib.Path, node: int,
                    record: dict[str, Any]) -> pathlib.Path:
-    """Atomically publish one node's current status record."""
+    """Atomically publish one node's current status record (the shared
+    obs record shape: node + ts + fields, plus the monotonic seq)."""
     directory = pathlib.Path(directory)
     directory.mkdir(parents=True, exist_ok=True)
-    rec = {"node": int(node), "ts": time.time(), **record}
+    rec = make_record(int(node), **record)
+    rec.setdefault("seq", _next_seq(directory, node))
     path = directory / f"node_{node}.status.json"
     tmp = path.with_suffix(".json.tmp")
     tmp.write_text(json.dumps(rec))
@@ -55,17 +74,22 @@ def read_statuses(directory: str | pathlib.Path) -> list[dict[str, Any]]:
 
 
 _COLUMNS = ("node", "role", "round", "loss", "accuracy", "trust",
-            "peers", "age")
+            "peers", "p95s", "io_mb", "age")
 
 
 def _row(rec: dict[str, Any], now: float, liveness_s: float) -> dict[str, str]:
-    age = now - float(rec.get("ts", 0.0))
+    # clamp: cross-host clock skew can put a record's ts slightly in
+    # this reader's future, and a rendered "-0.3s" age reads as a bug.
+    # Liveness is unaffected (a negative age was always alive).
+    age = max(now - float(rec.get("ts", 0.0)), 0.0)
     alive = age <= liveness_s
 
     def num(key):
         v = rec.get(key)
         return "-" if v is None else (f"{v:.4f}" if isinstance(v, float) else str(v))
 
+    bi, bo = rec.get("bytes_in"), rec.get("bytes_out")
+    p95 = rec.get("round_p95_s")
     return {
         "node": str(rec.get("node", "?")),
         "role": str(rec.get("role", "-")),
@@ -76,6 +100,13 @@ def _row(rec: dict[str, Any], now: float, liveness_s: float) -> dict[str, str]:
         # adversary.reputation); "-" on clean runs
         "trust": num("trust"),
         "peers": num("peers"),
+        # obs summaries (round-9): p95 round wall time + wire traffic
+        # in/out MB — published by launch.py/scenario.py status loops
+        "p95s": "-" if p95 is None else f"{float(p95):.2f}",
+        "io_mb": (
+            "-" if bi is None and bo is None
+            else f"{(bi or 0) / 1e6:.1f}/{(bo or 0) / 1e6:.1f}"
+        ),
         "age": f"{age:.1f}s" + ("" if alive else " DEAD"),
     }
 

@@ -30,7 +30,7 @@ def test_zero_budget_still_emits_parseable_json():
     # everywhere), every phase is explicitly accounted as skipped
     assert set(out["skipped_phases"]) == {
         "headline", "cifar16", "cpu8", "socket24", "socket_mp",
-        "robust", "vit32"
+        "obs", "robust", "vit32"
     }
 
 
@@ -57,6 +57,42 @@ def test_robust_phase_dry_run_emits_variant_plan():
         "robust_acc_signflip_krum", "robust_acc_signflip_trimmedmean",
         "robust_acc_signflip_repfedavg",
     }
+
+
+def test_obs_phase_dry_run_emits_key_plan():
+    """P2PFL_OBS_DRY=1: the obs phase must emit its planned key list
+    as one parseable part without touching jax — the round-9 analog of
+    the robust dry-run hook."""
+    env = dict(os.environ, P2PFL_OBS_DRY="1")
+    code = (f"import sys; sys.path.insert(0, {str(REPO)!r})\n"
+            "import bench; bench._phase_obs()\n")
+    res = subprocess.run([sys.executable, "-c", code],
+                         capture_output=True, text=True, timeout=60,
+                         env=env, cwd=REPO)
+    assert res.returncode == 0, res.stderr[-500:]
+    sys.path.insert(0, str(REPO))
+    import bench
+
+    parts = [json.loads(line[len(bench._PART_TAG):])
+             for line in res.stdout.splitlines()
+             if line.startswith(bench._PART_TAG)]
+    assert len(parts) == 1 and parts[0]["obs_dry"] is True
+    planned = set(parts[0]["obs_keys"])
+    assert {"obs_overhead_pct", "obs_round_s_untraced",
+            "obs_round_s_traced", "obs_xla_recompiles"} <= planned
+    # every planned key must be registered (and, via
+    # check_bench_keys, documented)
+    assert planned <= set(bench.BENCH_KEYS)
+
+
+def test_bench_keys_registry_in_sync_with_docs():
+    """scripts/check_bench_keys.py: every registered key documented in
+    docs/perf.md, every literal emission key registered."""
+    res = subprocess.run(
+        [sys.executable, str(REPO / "scripts" / "check_bench_keys.py")],
+        capture_output=True, text=True, timeout=60, cwd=REPO)
+    assert res.returncode == 0, res.stdout + res.stderr[-500:]
+    assert res.stdout.startswith("ok:")
 
 
 def test_stream_child_keeps_parts_from_failing_child():

@@ -41,6 +41,71 @@ def test_render_liveness(tmp_path):
     assert "class='dead'" in page and "class='alive'" in page
 
 
+def test_publish_seq_monotonic_and_age_clamped(tmp_path):
+    """Round 9: records carry a per-(dir, node) monotonic ``seq`` (the
+    skew-free ordering key) and future-dated ``ts`` renders as 0.0s,
+    never a negative age."""
+    p = publish_status(tmp_path, 2, {"role": "trainer", "round": 1})
+    first = json.loads(p.read_text())
+    p = publish_status(tmp_path, 2, {"role": "trainer", "round": 2})
+    second = json.loads(p.read_text())
+    assert first["seq"] == 1 and second["seq"] == 2
+    assert second["ts"] >= first["ts"]
+    # a record from a fast-clock host: ts in this reader's future
+    skewed = dict(second, ts=time.time() + 3.0)
+    p.write_text(json.dumps(skewed))
+    table = render_table(read_statuses(tmp_path))
+    row = table.splitlines()[2]
+    assert "-" not in row.split()[-1]  # age cell, no "-3.0s"
+    assert "0.0s" in row and "DEAD" not in row
+
+
+def test_trust_column_clean_vs_reputation(tmp_path):
+    """The trust column reads "-" on a clean run and the published
+    scalar on a reputation-weighted one."""
+    publish_status(tmp_path, 0, {"role": "aggregator", "round": 1})
+    publish_status(tmp_path, 1, {"role": "aggregator", "round": 1,
+                                 "trust": 0.875})
+    table = render_table(read_statuses(tmp_path))
+    lines = table.splitlines()
+    assert lines[0].split()[5] == "TRUST"
+    assert lines[2].split()[5] == "-"
+    assert "0.8750" in lines[3]
+
+
+def test_render_table_html_dead_row_styling(tmp_path):
+    from p2pfl_tpu.utils.monitor import render_table_html
+
+    publish_status(tmp_path, 0, {"role": "trainer", "round": 1})
+    path = publish_status(tmp_path, 1, {"role": "trainer", "round": 1})
+    stale = json.loads(path.read_text())
+    stale["ts"] = time.time() - 60
+    path.write_text(json.dumps(stale))
+    frag = render_table_html(read_statuses(tmp_path))
+    assert frag.startswith("<table>") and frag.endswith("</table>")
+    assert frag.count("<tr class='alive'>") == 1
+    assert frag.count("<tr class='dead'>") == 1
+    # header carries every column, incl. the round-9 obs summaries
+    for col in ("NODE", "TRUST", "P95S", "IO_MB", "AGE"):
+        assert f"<th>{col}</th>" in frag
+
+
+def test_watch_once_writes_both_outputs(tmp_path, capsys):
+    from p2pfl_tpu.utils.monitor import watch
+
+    publish_status(tmp_path, 0, {"role": "trainer", "round": 5,
+                                 "round_p95_s": 1.234,
+                                 "bytes_in": 2_500_000,
+                                 "bytes_out": 1_000_000})
+    html_out = tmp_path / "dash.html"
+    watch(tmp_path, once=True, html_out=str(html_out))
+    out = capsys.readouterr().out
+    assert "NODE" in out and "1.23" in out and "2.5/1.0" in out
+    page = html_out.read_text()
+    assert "<table>" in page and "1.23" in page
+    assert not list(tmp_path.glob("*.html.tmp"))
+
+
 def test_scenario_publishes_status(tmp_path):
     from p2pfl_tpu.federation.scenario import Scenario
 

@@ -578,22 +578,29 @@ def test_stop_announcement_evicts_immediately():
     asyncio.run(main())
 
 
-def test_multiprocess_launch(tmp_path):
+def test_multiprocess_launch(tmp_path, monkeypatch):
     """Whole-process federation over sockets (controller.py start_nodes
     analog): 4 nodes packed as 2 OS processes × 2 nodes per event loop
     (the k-per-process layout the multi-process bench measures), CPU
-    backend, one round each."""
+    backend, one round each — run with P2PFL_TRACE=1 so each process
+    exports a trace file and the traceview merge is exercised on a real
+    multi-process federation (round-9 acceptance)."""
+    import json
+
     from p2pfl_tpu.config.schema import ScenarioConfig, TrainingConfig
+    from p2pfl_tpu.obs import traceview
     from p2pfl_tpu.p2p.launch import launch
 
     from p2pfl_tpu.config.schema import DataConfig as DC
 
+    monkeypatch.setenv("P2PFL_TRACE", "1")  # inherited by node procs
     cfg = ScenarioConfig(
         name="mp", n_nodes=4, topology="fully",
         data=DC(dataset="mnist", samples_per_node=120),
         training=TrainingConfig(rounds=1, epochs_per_round=1,
                                 learning_rate=0.05),
         protocol=ProtocolConfig(heartbeat_period_s=0.5, vote_timeout_s=10.0),
+        log_dir=str(tmp_path),
     )
     path = tmp_path / "scenario.json"
     cfg.save(path)
@@ -604,6 +611,35 @@ def test_multiprocess_launch(tmp_path):
     # the round-loop wall clock every node reports is what the bench's
     # multi-process round_s is computed from
     assert all(r["learn_wall_s"] > 0 for r in res)
+    # obs summaries ride along in every result record
+    assert all(r["round_p95_s"] > 0 for r in res)
+    assert all(r["bytes_in"] > 0 and r["bytes_out"] > 0 for r in res)
+
+    # each of the 2 node processes exported its own trace file into the
+    # launcher-wired dir, and traceview merges them into one valid
+    # Chrome trace-event document
+    trace_dir = tmp_path / "mp" / "trace"
+    files = sorted(trace_dir.glob("proc*.trace.json"))
+    assert len(files) == 2
+    merged_path = tmp_path / "merged.trace.json"
+    assert traceview.main([str(trace_dir), "-o", str(merged_path)]) == 0
+    merged = json.loads(merged_path.read_text())
+    assert set(merged) == {"traceEvents", "displayTimeUnit", "metadata"}
+    assert merged["metadata"]["files"] == 2
+    events = merged["traceEvents"]
+    assert {e["ph"] for e in events} <= {"M", "X", "C"}
+    assert len({e["pid"] for e in events}) == 2
+    lanes = {e["args"]["name"] for e in events
+             if e["ph"] == "M" and e["name"] == "thread_name"}
+    assert {"node0", "node1", "node2", "node3"} <= lanes
+    span_names = {e["name"] for e in events if e["ph"] == "X"}
+    assert "node.round" in span_names
+    assert any(n.startswith("session.") for n in span_names)
+    # per-process wire counters made it into the merged metadata
+    by_pid = merged["metadata"]["counters_by_pid"]
+    assert len(by_pid) == 2
+    assert all(any(k.startswith("rx_bytes/") for k in c)
+               for c in by_pid.values())
 
 
 def test_eight_node_socket_federation_with_vote_cap():

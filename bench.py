@@ -124,7 +124,7 @@ def _build(n: int, *, dataset="femnist", model="femnist-cnn",
            topology="ring", aggregator=None, partition="iid",
            samples_per_node=750, batch_size=336, learning_rate=0.05,
            optimizer="sgd", momentum_dtype=None,
-           exchange_dtype="bf16", seed=0,
+           exchange_dtype="bf16", exchange_overlap="off", seed=0,
            model_kwargs=None, shared_aggregate=False,
            surrogate_profile="hard",
            attack=None, malicious=None, reputation=False):
@@ -143,6 +143,7 @@ def _build(n: int, *, dataset="femnist", model="femnist-cnn",
         build_round_fn,
         init_federation,
         make_round_plan,
+        with_staged_buffer,
     )
     from p2pfl_tpu.parallel.transport import MeshTransport
     from p2pfl_tpu.topology.topology import generate_topology
@@ -168,8 +169,14 @@ def _build(n: int, *, dataset="femnist", model="femnist-cnn",
     topo = generate_topology(topology, n, **topo_kw)
     plan = make_round_plan(topo, ["aggregator"] * n, "DFL")
     tr = MeshTransport(n)
-    fed = tr.put_stacked(init_federation(fns, jnp.asarray(x[0, :1]), n,
-                                         seed=seed))
+
+    def _init(s: int):
+        f = init_federation(fns, jnp.asarray(x[0, :1]), n, seed=s)
+        # staged mode ships a double buffer; seed it at zero weight so
+        # round 0 degenerates to pure local training
+        return with_staged_buffer(f) if exchange_overlap == "staged" else f
+
+    fed = tr.put_stacked(_init(seed))
     fargs = tuple(
         tr.put_stacked(jnp.asarray(a))
         for a in (x, y, smask, nsamp, plan.mix, plan.adopt, plan.trains)
@@ -178,6 +185,7 @@ def _build(n: int, *, dataset="femnist", model="femnist-cnn",
     round_fn = tr.compile_round(
         build_round_fn(fns, aggregator=aggregator, epochs=1,
                        exchange_dtype=ex_dt,
+                       exchange_overlap=exchange_overlap,
                        shared_aggregate=shared_aggregate,
                        identity_adopt=True,  # _build is always DFL
                        attack=attack, malicious=malicious,
@@ -190,9 +198,7 @@ def _build(n: int, *, dataset="femnist", model="femnist-cnn",
         """Fresh federation state for the SAME compiled programs —
         jit caches key on the function object, so rebuilding round_fn
         would recompile."""
-        return tr.put_stacked(
-            init_federation(fns, jnp.asarray(x[0, :1]), n, seed=new_seed)
-        )
+        return tr.put_stacked(_init(new_seed))
 
     return {
         "n": n, "ds": ds, "fns": fns, "tr": tr, "fed": fed,
@@ -207,6 +213,7 @@ def _build(n: int, *, dataset="femnist", model="femnist-cnn",
                        momentum_dtype=momentum_dtype,
                        samples_per_node=samples_per_node,
                        exchange_dtype=exchange_dtype,
+                       exchange_overlap=exchange_overlap,
                        shared_aggregate=shared_aggregate,
                        surrogate_profile=surrogate_profile,
                        model_kwargs=model_kwargs or {}),
@@ -268,6 +275,7 @@ def _rebuild_body_round(run):
     return build_round_fn(
         run["fns"], aggregator=run.get("aggregator") or FedAvg(),
         epochs=1, exchange_dtype=ex_dt,
+        exchange_overlap=cfg.get("exchange_overlap", "off"),
         shared_aggregate=cfg.get("shared_aggregate", False),
         identity_adopt=True,
         attack=run.get("attack"), malicious=run.get("malicious"),
@@ -925,11 +933,56 @@ def _part(d: dict) -> None:
     print(_PART_TAG + json.dumps(d), flush=True)
 
 
+def _ab_interleaved(run_a, run_b, pairs: int = 2, key: str = "round_s",
+                    on_run=None):
+    """Interleaved A/B with min-of-``pairs`` selection — the pairing
+    discipline every perf gate here uses (obs phase, round-7 socket
+    A/Bs): the two arms run strictly alternated (A,B,A,B,...) so host
+    drift taxes both equally, and each arm keeps its best (minimum
+    ``key``) run — min drops scheduler hiccups a mean would keep.
+
+    ``run_a``/``run_b`` are zero-arg callables returning a result dict
+    (a run returning None or missing ``key`` is dropped at selection).
+    ``on_run(tag, i, result)`` — tag "a"/"b", pair index i — fires
+    after every run; phases use it to stream partial parts so a
+    mid-phase kill keeps the first arm's number.
+
+    Returns ``(best_a, best_b)``; either side is None when no run of
+    that arm produced ``key``."""
+    runs_a: list = []
+    runs_b: list = []
+    for i in range(pairs):
+        for runs, fn, tag in ((runs_a, run_a, "a"), (runs_b, run_b, "b")):
+            r = fn() or {}
+            runs.append(r)
+            if on_run is not None:
+                on_run(tag, i, r)
+
+    def best(rs):
+        good = [r for r in rs if r.get(key) is not None]
+        return min(good, key=lambda r: r[key]) if good else None
+
+    return best(runs_a), best(runs_b)
+
+
 # span families the obs phase attributes round time to (see
 # docs/observability.md); kept static so BENCH_KEYS stays authoritative
 _OBS_ATTR_SPANS = ("node.round", "node.fit", "learner.fit",
                    "learner.evaluate", "session.add_model",
                    "session.aggregate", "scenario.round", "p2p.verify")
+
+# keys the comm phase (round 10: overlap + wire-dtype A/Bs) emits;
+# static so BENCH_KEYS and the P2PFL_COMM_DRY plan stay authoritative
+_COMM_KEYS = (
+    "wire_f32_round_s_24node_uncapped",
+    "wire_bf16_round_s_24node_uncapped",
+    "wire_payload_bytes_per_round_f32", "wire_payload_bytes_per_round",
+    "wire_payload_reduction", "wire_accuracy_f32", "wire_accuracy_bf16",
+    "wire_xla_recompiles",
+    "overlap_off_round_s", "overlap_round_s",
+    "overlap_off_rounds_to_80pct", "overlap_rounds_to_80pct",
+    "overlap_xla_recompiles",
+)
 
 # Authoritative registry of every top-level key bench can emit.
 # scripts/check_bench_keys.py asserts each one is documented in
@@ -970,6 +1023,8 @@ BENCH_KEYS = (
     "obs_dry", "obs_keys", "obs_round_s_untraced", "obs_round_s_traced",
     "obs_overhead_pct", "obs_xla_recompiles", "obs_trace_file_bytes",
     *("obs_attr_" + s.replace(".", "_") + "_s" for s in _OBS_ATTR_SPANS),
+    # comm (round 10: overlap + wire-dtype A/Bs)
+    "comm_dry", "comm_keys", *_COMM_KEYS,
     # orchestration-test hook
     "selftest_key",
 )
@@ -1284,27 +1339,28 @@ def _phase_obs() -> None:
             os.environ["P2PFL_TRACE"] = "0"
 
     with tempfile.TemporaryDirectory() as td:
-        # interleaved U,T,U,T with min-of-2 per mode: host drift hits
-        # both modes equally and min drops scheduler hiccups — a single
-        # pair on a busy host measured ±30% run-to-run noise, far above
-        # the signal being gated
-        u1 = sim(False)
-        _part({"obs_round_s_untraced": u1.get("round_s")})
-        t1 = sim(True, td)
-        u2 = sim(False)
-        t2 = sim(True, td)
-        us = [r["round_s"] for r in (u1, u2) if r.get("round_s")]
-        traced_runs = [r for r in (t1, t2) if r.get("round_s")]
-        best_t = (min(traced_runs, key=lambda r: r["round_s"])
-                  if traced_runs else None)
-        part = {"obs_round_s_untraced": min(us) if us else None,
+        # interleaved U,T,U,T with min-of-2 per mode (_ab_interleaved):
+        # host drift hits both modes equally and min drops scheduler
+        # hiccups — a single pair on a busy host measured ±30%
+        # run-to-run noise, far above the signal being gated
+        def on_run(tag, i, r):
+            if tag == "a" and i == 0:
+                # stream the first untraced number: a mid-phase kill
+                # keeps it
+                _part({"obs_round_s_untraced": r.get("round_s")})
+
+        best_u, best_t = _ab_interleaved(
+            lambda: sim(False), lambda: sim(True, td), on_run=on_run)
+        part = {"obs_round_s_untraced":
+                    best_u["round_s"] if best_u else None,
                 "obs_round_s_traced":
                     best_t["round_s"] if best_t else None,
                 "obs_xla_recompiles":
                     best_t.get("xla_recompiles") if best_t else None}
-        if us and best_t:
+        if best_u and best_t:
             part["obs_overhead_pct"] = round(
-                100.0 * (best_t["round_s"] - min(us)) / min(us), 2)
+                100.0 * (best_t["round_s"] - best_u["round_s"])
+                / best_u["round_s"], 2)
         spans = ((best_t or {}).get("obs") or {}).get("spans") or {}
         for name in _OBS_ATTR_SPANS:
             if name in spans:
@@ -1315,6 +1371,151 @@ def _phase_obs() -> None:
             part["obs_trace_file_bytes"] = sum(
                 p.stat().st_size for p in traces)
         _part(part)
+
+
+def _phase_comm() -> None:
+    """Communication A/Bs (round 10: hide the wire under the fit),
+    both planes, each interleaved min-of-2 via ``_ab_interleaved``:
+
+    (a) socket wire dtype — the 24-node UNCAPPED simulation scenario
+        (the round-7 payload-bound config, every node trains and
+        gossips) with ``wire_dtype`` f32 vs bf16. Gates: payload
+        bytes/round reduced >= 1.9x, same-seed accuracy identical,
+        post-warm-up recompiles unchanged. Runs in a CPU subprocess
+        like _socket24 (asyncio nodes cannot share the bench chip).
+    (b) SPMD overlap — the 64-node femnist-cnn headline build with
+        ``exchange_overlap`` off vs staged (one-round-stale gossip,
+        docs/perf.md §11): steady-state round time per arm, then
+        rounds-to-80 per arm to pin convergence, and the post-warm-up
+        recompile counter (must stay 0 — staged adds no shape churn).
+
+    The socket A/B runs first: it is the cheaper arm and must survive
+    a mid-phase kill of the accelerator build.
+
+    ``P2PFL_COMM_DRY=1`` emits the key plan without touching the
+    accelerator — the orchestration test's smoke hook."""
+    if os.environ.get("P2PFL_COMM_DRY") == "1":
+        _part({"comm_dry": True, "comm_keys": list(_COMM_KEYS)})
+        return
+
+    import json as _json
+    import subprocess
+
+    # ---- (a) socket wire-dtype A/B: 24-node uncapped, f32 vs bf16 ----
+    code = r"""
+import os, re, json
+flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+               os.environ.get("XLA_FLAGS", "")).strip()
+os.environ["XLA_FLAGS"] = flags
+import jax
+jax.config.update("jax_platforms", "cpu")
+import sys; sys.path.insert(0, %r)
+import bench
+from p2pfl_tpu.config.schema import (ScenarioConfig, TrainingConfig,
+    ProtocolConfig, DataConfig)
+from p2pfl_tpu.p2p.launch import run_simulation
+
+def cfg(wd):
+    return ScenarioConfig(
+        name="comm24u", n_nodes=24, topology="fully",
+        data=DataConfig(dataset="mnist", samples_per_node=60),
+        training=TrainingConfig(rounds=3, epochs_per_round=1,
+                                learning_rate=0.05),
+        protocol=ProtocolConfig(heartbeat_period_s=0.5,
+                                aggregation_timeout_s=60.0,
+                                vote_timeout_s=10.0, train_set_size=24,
+                                gossip_fanout=12),
+        wire_dtype=wd,
+    )
+
+def arm(wd):
+    def run():
+        out = run_simulation(cfg(wd), timeout=280)
+        out["payload_per_round"] = round(
+            (out.get("params_bytes_out") or 0)
+            / max(out.get("rounds") or 1, 1))
+        return out
+    return run
+
+f32, bf16 = bench._ab_interleaved(arm("f32"), arm("bf16"))
+print("BENCH_COMMWIRE " + json.dumps({"f32": f32, "bf16": bf16}),
+      flush=True)
+""" % (_REPO,)
+    try:
+        res = subprocess.run([sys.executable, "-c", code],
+                             capture_output=True, text=True, timeout=420)
+        got = None
+        for line in res.stdout.splitlines():
+            if line.startswith("BENCH_COMMWIRE "):
+                got = _json.loads(line[len("BENCH_COMMWIRE "):])
+        if not got:
+            print(f"comm wire child rc={res.returncode}: "
+                  f"{res.stderr[-400:]}", file=sys.stderr, flush=True)
+        else:
+            f32, bf16 = got.get("f32") or {}, got.get("bf16") or {}
+            part = {
+                "wire_f32_round_s_24node_uncapped": f32.get("round_s"),
+                "wire_bf16_round_s_24node_uncapped": bf16.get("round_s"),
+                "wire_payload_bytes_per_round_f32":
+                    f32.get("payload_per_round"),
+                "wire_payload_bytes_per_round":
+                    bf16.get("payload_per_round"),
+                "wire_accuracy_f32": f32.get("mean_accuracy"),
+                "wire_accuracy_bf16": bf16.get("mean_accuracy"),
+                "wire_xla_recompiles": bf16.get("xla_recompiles"),
+            }
+            if (part["wire_payload_bytes_per_round"]
+                    and part["wire_payload_bytes_per_round_f32"]):
+                part["wire_payload_reduction"] = round(
+                    part["wire_payload_bytes_per_round_f32"]
+                    / part["wire_payload_bytes_per_round"], 2)
+            _part(part)
+    except Exception as e:
+        print(f"comm wire A/B failed: {e!r}"[:300], file=sys.stderr,
+              flush=True)
+
+    # ---- (b) SPMD overlap A/B: 64-node headline, off vs staged ----
+    try:
+        import jax
+
+        from p2pfl_tpu.obs import trace as obs_trace
+
+        obs_trace.install_xla_listener()
+        run_off = _build(64, exchange_overlap="off")
+        run_st = _build(64, exchange_overlap="staged")
+
+        def arm(run):
+            return lambda: {"round_s": _time_chained(run, k=5, reps=1)}
+
+        best_off, best_st = _ab_interleaved(arm(run_off), arm(run_st))
+        # both programs are warm now: steady-state rounds must not
+        # compile anything further on either arm
+        obs_trace.reset_xla_counters()
+        _time_chained(run_off, k=2, reps=1)
+        _time_chained(run_st, k=2, reps=1)
+        _part({"overlap_off_round_s":
+                   round(best_off["round_s"], 4) if best_off else None,
+               "overlap_round_s":
+                   round(best_st["round_s"], 4) if best_st else None,
+               "overlap_xla_recompiles": obs_trace.xla_recompiles()})
+
+        # convergence pin: rounds-to-80 per arm (trajectory re-runs
+        # drop the timing federations first — _accuracy_run resets)
+        run_off["fed"] = run_st["fed"] = None
+        r80_off, _, _, _ = _accuracy_run(run_off, target=0.80,
+                                         max_rounds=30,
+                                         measure_seconds=False)
+        _part({"overlap_off_rounds_to_80pct": r80_off})
+        r80_st, _, _, _ = _accuracy_run(run_st, target=0.80,
+                                        max_rounds=30,
+                                        measure_seconds=False)
+        _part({"overlap_rounds_to_80pct": r80_st})
+        run_off.clear()
+        run_st.clear()
+        jax.clear_caches()
+    except Exception as e:
+        print(f"comm overlap A/B failed: {e!r}"[:300], file=sys.stderr,
+              flush=True)
 
 
 def _phase_selftest() -> None:
@@ -1455,6 +1656,7 @@ def main() -> None:
         ("cifar16", "_phase_cifar16", 120),
         ("cpu8", "_phase_cpu8", 45),
         ("socket24", "_phase_socket24", 45),
+        ("comm", "_phase_comm", 150),
         ("socket_mp", "_phase_socket_mp", 150),
         ("obs", "_phase_obs", 90),
         ("robust", "_phase_robust", 150),

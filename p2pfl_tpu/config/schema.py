@@ -226,6 +226,20 @@ class ScenarioConfig:
     # one node per device only); "auto" picks sparse when it is legal
     # and the topology is sparse enough to win
     transport: str = "auto"
+    # wire precision of the exchanged weights — ONE knob for every
+    # path: the SPMD dense mix, the sparse ppermute hops, the DCN round
+    # and the socket PARAMS payload. "f32" ships full precision; "bf16"
+    # halves the moved bytes (aggregation still accumulates in f32 on
+    # every path); "int8" additionally quantizes socket payloads with
+    # per-leaf scales + error feedback (socket plane only — SPMD falls
+    # back to bf16 exchange under int8)
+    wire_dtype: str = "f32"
+    # SPMD double-buffered neighbor exchange: "staged" gossips the
+    # PREVIOUS round's post-fit params so the ICI transfer overlaps the
+    # current local epochs (one-round-stale decentralized SGD). Default
+    # "off" — convergence must be pinned by the bench A/B before a
+    # scenario opts in (docs/perf.md §11).
+    exchange_overlap: str = "off"
     # mutual TLS on the socket path (the reference's encrypter knob,
     # base_node.py:62; scenario certs minted at launch)
     encrypt: bool = False
@@ -254,6 +268,16 @@ class ScenarioConfig:
             raise ValueError(
                 f"unknown transport {self.transport!r}; "
                 "have ('auto', 'dense', 'sparse')"
+            )
+        if self.wire_dtype not in ("f32", "bf16", "int8"):
+            raise ValueError(
+                f"unknown wire_dtype {self.wire_dtype!r}; "
+                "have ('f32', 'bf16', 'int8')"
+            )
+        if self.exchange_overlap not in ("off", "staged"):
+            raise ValueError(
+                f"unknown exchange_overlap {self.exchange_overlap!r}; "
+                "have ('off', 'staged')"
             )
         if self.n_nodes < 1:
             raise ValueError("n_nodes must be >= 1")

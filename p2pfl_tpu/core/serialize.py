@@ -23,8 +23,58 @@ import numpy as np
 from flax import serialization as flax_ser
 
 _MAGIC = b"P2TP"  # p2pfl_tpu params
-_VERSION = 1
+_VERSION = 1  # full-precision envelope (the only one v1 decoders accept)
+# envelope v2 adds a wire-dtype segment in the body: "d" names the
+# reduced precision ("bf16" | "int8"), "dt" records each leaf's
+# original dtype (flatten order) so decode restores it exactly, and
+# int8 additionally carries per-leaf scales under "s". A v1-only
+# decoder rejects v2 loudly via its version check — reduced-precision
+# payloads are only sent to peers that advertised support (p2p.node's
+# CONNECT-hello negotiation), so mixed fleets interoperate at f32.
+_VERSION_QUANT = 2
 _HEADER = struct.Struct(">4sHII")  # magic, version, contributor-count, crc32
+
+#: wire precisions ``encode_parameters`` can ship (config.wire_dtype)
+WIRE_DTYPES = ("f32", "bf16", "int8")
+
+
+def _is_float(x) -> bool:
+    return jnp.issubdtype(np.asarray(x).dtype, jnp.floating)
+
+
+def quantize_int8(params: Any) -> tuple[Any, list[float]]:
+    """Symmetric per-leaf int8 quantization of the floating leaves.
+
+    Returns the quantized tree plus one scale per leaf in flatten
+    order; non-float leaves pass through with scale 0.0 as the
+    "untouched" marker. f32 accumulation + ``dequantize_int8`` keep
+    aggregation parity — the only error is the rounding at encode.
+    """
+    leaves, treedef = jax.tree.flatten(params)
+    q, scales = [], []
+    for leaf in leaves:
+        a = np.asarray(leaf)
+        if _is_float(a):
+            f = a.astype(np.float32)
+            scale = float(np.max(np.abs(f)) / 127.0) if f.size else 0.0
+            if scale == 0.0:
+                scale = 1.0
+            q.append(np.clip(np.rint(f / scale), -127, 127).astype(np.int8))
+            scales.append(scale)
+        else:
+            q.append(a)
+            scales.append(0.0)
+    return jax.tree.unflatten(treedef, q), scales
+
+
+def dequantize_int8(params: Any, scales: list[float]) -> Any:
+    """Inverse of ``quantize_int8``: int8 leaves back to float32."""
+    leaves, treedef = jax.tree.flatten(params)
+    out = [
+        np.asarray(leaf).astype(np.float32) * np.float32(s) if s else leaf
+        for leaf, s in zip(leaves, scales)
+    ]
+    return jax.tree.unflatten(treedef, out)
 
 
 class DecodingParamsError(Exception):
@@ -51,13 +101,45 @@ class ParamsPayload:
     weight: int = 1
 
 
-def encode_parameters(params: Any, contributors: tuple[int, ...] = (), weight: int = 1) -> bytes:
-    """Encode a params pytree + metadata into a self-describing payload."""
+def encode_parameters(params: Any, contributors: tuple[int, ...] = (),
+                      weight: int = 1,
+                      wire_dtype: str | None = None) -> bytes:
+    """Encode a params pytree + metadata into a self-describing payload.
+
+    ``wire_dtype`` None/"f32" emits the byte-identical v1 envelope;
+    "bf16" casts floating leaves to bfloat16 on the wire (half the
+    payload bytes), "int8" quantizes them with per-leaf scales
+    (quarter). Both reduced forms stamp envelope version 2, so a
+    decoder that predates them refuses loudly instead of misreading.
+    """
     host_params = jax.tree.map(np.asarray, params)
-    body = flax_ser.msgpack_serialize({"p": host_params, "w": np.int64(weight)})
+    if wire_dtype in (None, "f32"):
+        version = _VERSION
+        body = flax_ser.msgpack_serialize(
+            {"p": host_params, "w": np.int64(weight)})
+    elif wire_dtype == "bf16":
+        version = _VERSION_QUANT
+        dts = [str(np.asarray(a).dtype)
+               for a in jax.tree.leaves(host_params)]
+        wire = jax.tree.map(
+            lambda a: a.astype(jnp.bfloat16) if _is_float(a) else a,
+            host_params)
+        body = flax_ser.msgpack_serialize(
+            {"p": wire, "w": np.int64(weight), "d": "bf16", "dt": dts})
+    elif wire_dtype == "int8":
+        version = _VERSION_QUANT
+        dts = [str(np.asarray(a).dtype)
+               for a in jax.tree.leaves(host_params)]
+        wire, scales = quantize_int8(host_params)
+        body = flax_ser.msgpack_serialize(
+            {"p": wire, "w": np.int64(weight), "d": "int8", "dt": dts,
+             "s": np.asarray(scales, np.float32)})
+    else:
+        raise ValueError(
+            f"unknown wire_dtype {wire_dtype!r}; have {WIRE_DTYPES}")
     contrib = struct.pack(f">{len(contributors)}I", *contributors)
     crc = zlib.crc32(contrib + body)
-    header = _HEADER.pack(_MAGIC, _VERSION, len(contributors), crc)
+    header = _HEADER.pack(_MAGIC, version, len(contributors), crc)
     return header + contrib + body
 
 
@@ -73,7 +155,7 @@ def decode_parameters(blob: bytes) -> ParamsPayload:
     try:
         mv = memoryview(blob)
         magic, version, n_contrib, crc = _HEADER.unpack_from(mv, 0)
-        if magic != _MAGIC or version != _VERSION:
+        if magic != _MAGIC or version not in (_VERSION, _VERSION_QUANT):
             raise ValueError(f"bad magic/version {magic!r}/{version}")
         if zlib.crc32(mv[_HEADER.size :]) != crc:
             raise ValueError("payload CRC mismatch (corrupt or tampered)")
@@ -81,8 +163,27 @@ def decode_parameters(blob: bytes) -> ParamsPayload:
         contributors = struct.unpack_from(f">{n_contrib}I", mv, off)
         off += 4 * n_contrib
         obj = flax_ser.msgpack_restore(mv[off:])
+        p = obj["p"]
+        if version == _VERSION_QUANT:
+            wd = obj.get("d")
+            if wd == "int8":
+                p = dequantize_int8(
+                    p, [float(s) for s in np.asarray(obj["s"])])
+            elif wd != "bf16":
+                raise ValueError(f"unknown wire dtype {wd!r} in v2 envelope")
+            # restore each leaf's recorded origin dtype so aggregation
+            # (f32-accumulating numpy FedAvg) and check_parameters see
+            # exactly the shapes/dtypes the sender's model holds
+            dts = obj["dt"]
+            leaves, treedef = jax.tree.flatten(p)
+            if len(dts) != len(leaves):
+                raise ValueError("wire-dtype leaf table length mismatch")
+            p = jax.tree.unflatten(
+                treedef,
+                [np.asarray(leaf).astype(np.dtype(dt))
+                 for leaf, dt in zip(leaves, dts)])
         return ParamsPayload(
-            params=obj["p"], contributors=tuple(contributors), weight=int(obj["w"])
+            params=p, contributors=tuple(contributors), weight=int(obj["w"])
         )
     except DecodingParamsError:
         raise
@@ -102,16 +203,21 @@ def check_parameters(params: Any, template: Any) -> None:
         raise ModelNotMatchingError(
             f"pytree structure mismatch: got {p_struct}, want {t_struct}"
         )
-    for got, want in zip(jax.tree.leaves(params), jax.tree.leaves(template)):
+    got_leaves = jax.tree_util.tree_flatten_with_path(params)[0]
+    want_leaves = jax.tree.leaves(template)
+    for (path, got), want in zip(got_leaves, want_leaves):
+        where = jax.tree_util.keystr(path)
         got_shape = jnp.shape(got)
         want_shape = jnp.shape(want)
         if got_shape != want_shape:
             raise ModelNotMatchingError(
-                f"leaf shape mismatch: got {got_shape}, want {want_shape}"
+                f"leaf {where} shape mismatch: "
+                f"got {got_shape}, want {want_shape}"
             )
         got_dtype = jnp.result_type(got)
         want_dtype = jnp.result_type(want)
         if got_dtype != want_dtype:
             raise ModelNotMatchingError(
-                f"leaf dtype mismatch: got {got_dtype}, want {want_dtype}"
+                f"leaf {where} dtype mismatch: "
+                f"got {got_dtype}, want {want_dtype}"
             )

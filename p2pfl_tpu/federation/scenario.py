@@ -53,6 +53,7 @@ from p2pfl_tpu.parallel.federated import (
     build_round_fn_sparse,
     init_federation,
     make_round_plan,
+    with_staged_buffer,
 )
 from p2pfl_tpu.obs import trace as obs_trace
 from p2pfl_tpu.parallel.transport import MeshTransport, edge_offsets
@@ -181,10 +182,19 @@ class Scenario(Observable):
         self._x_test = tr.put_replicated(jnp.asarray(self.dataset.x_test))
         self._y_test = tr.put_replicated(jnp.asarray(self.dataset.y_test))
         self.sparse_transport = self._choose_sparse()
+        # ONE wire-precision knob (config.wire_dtype) across planes:
+        # on the SPMD plane the exchange is device math, so bf16 is the
+        # hardware-native reduced precision; int8 (a socket-plane
+        # encoding with per-leaf scales) falls back to bf16 here
+        self._exchange_dtype = (
+            jnp.bfloat16 if config.wire_dtype in ("bf16", "int8") else None
+        )
         if self.sparse_transport:
             round_fn = build_round_fn_sparse(
                 self.fns, self.topology, tr.mesh,
                 epochs=config.training.epochs_per_round,
+                exchange_dtype=self._exchange_dtype,
+                exchange_overlap=config.exchange_overlap,
             )
         else:
             # one shared robust aggregate when every aggregating row is
@@ -201,6 +211,7 @@ class Scenario(Observable):
             round_fn = build_round_fn(
                 self.fns, aggregator=self.aggregator,
                 epochs=config.training.epochs_per_round,
+                exchange_dtype=self._exchange_dtype,
                 shared_aggregate=shared,
                 # DFL plans always adopt their own row (make_round_plan)
                 # -> the agg[adopt] whole-stack gather pass is elided;
@@ -209,13 +220,17 @@ class Scenario(Observable):
                 attack=self.attack,
                 malicious=self.malicious,
                 update_stats=self.reputation is not None,
+                exchange_overlap=config.exchange_overlap,
             )
         self._round_fn = tr.compile_round(round_fn)
         self._eval_fn = tr.compile_eval(build_eval_fn(self.fns))
-        self.fed = tr.put_stacked(
-            init_federation(self.fns, jnp.asarray(x[0, :1]), n,
-                            seed=config.seed)
-        )
+        fed0 = init_federation(self.fns, jnp.asarray(x[0, :1]), n,
+                               seed=config.seed)
+        if config.exchange_overlap == "staged":
+            # seed the double buffer at zero weight: staged round 0
+            # reduces to pure local training (with_staged_buffer)
+            fed0 = with_staged_buffer(fed0)
+        self.fed = tr.put_stacked(fed0)
         self._maybe_resume()
         self._steps_per_round = (
             max(x.shape[1] // config.data.batch_size, 1)

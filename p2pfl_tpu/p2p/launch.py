@@ -174,6 +174,7 @@ async def _run_node(cfg: ScenarioConfig, idx: int, ports: list[int],
         tls=tls,
         netem=cfg.network,
         full_mesh=_declares_full_mesh(cfg),
+        wire_dtype=cfg.wire_dtype,
         **adv_kwargs,
     )
     await node.start()
@@ -251,6 +252,7 @@ async def _run_node(cfg: ScenarioConfig, idx: int, ports: list[int],
     result = {"node": idx, "round": node.round,
               "round_p95_s": node.round_p95_s(),
               "bytes_in": node.bytes_in, "bytes_out": node.bytes_out,
+              "params_bytes_out": node.params_bytes_out,
               **metrics}
     # round-loop wall clock (post-warm-up, excludes startup/diffusion):
     # what socket_round_s_24node_multiproc is computed from
@@ -334,6 +336,7 @@ async def _simulate(cfg: ScenarioConfig, timeout: float = 600) -> dict:
             seed=cfg.seed,
             netem=cfg.network,
             full_mesh=_declares_full_mesh(cfg),
+            wire_dtype=cfg.wire_dtype,
             **adv_kwargs[i],
         )
         for i in range(n)
@@ -390,6 +393,9 @@ async def _simulate(cfg: ScenarioConfig, timeout: float = 600) -> dict:
         "xla_recompiles": obs_trace.xla_recompiles(),
         "bytes_in": sum(nd.bytes_in for nd in nodes),
         "bytes_out": sum(nd.bytes_out for nd in nodes),
+        # encoded PARAMS blob bytes × targets — the wire-dtype A/B's
+        # numerator, isolated from control-plane traffic
+        "params_bytes_out": sum(nd.params_bytes_out for nd in nodes),
     }
     if tracer.enabled:
         out["obs"] = tracer.summarize()

@@ -34,9 +34,18 @@ import secrets
 import time
 from typing import Any
 
+import jax
+import numpy as np
+
 from p2pfl_tpu.config.schema import ProtocolConfig
 from p2pfl_tpu.core.aggregators import Aggregator
-from p2pfl_tpu.core.serialize import decode_parameters, encode_parameters
+from p2pfl_tpu.core.serialize import (
+    WIRE_DTYPES,
+    decode_parameters,
+    dequantize_int8,
+    encode_parameters,
+    quantize_int8,
+)
 from p2pfl_tpu.federation.membership import Membership
 from p2pfl_tpu.obs.trace import get_tracer
 from p2pfl_tpu.p2p.protocol import (
@@ -117,6 +126,7 @@ class P2PNode:
         full_mesh: bool = False,
         attack=None,
         reputation=None,
+        wire_dtype: str = "f32",
     ):
         from p2pfl_tpu.p2p.session import AggregationSession
 
@@ -174,6 +184,28 @@ class P2PNode:
         # so finish-time aggregation is trust-weighted
         self.attack = attack
         self.reputation = reputation
+        # wire precision for PARAMS payloads (config.wire_dtype). The
+        # knob names what this node WANTS to ship; what it actually
+        # ships to a given target set is negotiated per send: every
+        # CONNECT hello carries the supported-dtype list ("wd"), and a
+        # reduced-precision payload goes out only when ALL targets of
+        # that send advertised the dtype — otherwise the send falls
+        # back to the f32 v1 envelope (one Message per target set, so
+        # precision is per-send, never per-peer re-encoded). Peers that
+        # predate the field advertise nothing and always get f32.
+        if wire_dtype not in WIRE_DTYPES:
+            raise ValueError(
+                f"unknown wire_dtype {wire_dtype!r}; have {WIRE_DTYPES}")
+        self.wire_dtype = wire_dtype
+        self._peer_wire: dict[int, tuple[str, ...]] = {}
+        # int8 error feedback: the quantization error of this node's
+        # own shipped update, carried into the next round's send so the
+        # rounding bias cancels over time instead of accumulating
+        # (residual lives host-side; reset on leaf-structure change)
+        self._ef_residual: list[Any] | None = None
+        # params payload bytes shipped (encoded blob size × targets):
+        # the wire-dtype A/B's numerator, isolated from control traffic
+        self.params_bytes_out = 0
         # obs wiring: the process tracer (configured in place, so the
         # cached reference stays valid across enable/disable) + always-
         # counted wire totals. The plain ints cost two adds per frame
@@ -331,12 +363,14 @@ class P2PNode:
         await write_message(
             writer,
             self._sign(Message(MsgType.CONNECT, self.idx,
-                               {"port": self.port})),
+                               {"port": self.port,
+                                "wd": list(WIRE_DTYPES)})),
         )
         hello = await read_message(reader)
         if not self._hello_ok(hello, writer):
             writer.close()
             raise ConnectionError("peer hello does not match its certificate")
+        self._record_peer_wire(hello)
         peer = self._register_peer(int(hello.sender), reader, writer)
         log.debug("node %d connected to %d", self.idx, peer.idx)
 
@@ -354,9 +388,20 @@ class P2PNode:
         await write_message(
             writer,
             self._sign(Message(MsgType.CONNECT, self.idx,
-                               {"port": self.port})),
+                               {"port": self.port,
+                                "wd": list(WIRE_DTYPES)})),
         )
+        self._record_peer_wire(hello)
         self._register_peer(int(hello.sender), reader, writer)
+
+    def _record_peer_wire(self, hello: Message) -> None:
+        """Remember the wire precisions the peer's CONNECT hello
+        advertised ("wd"). Absent on pre-quantization peers — they are
+        recorded as supporting nothing reduced, so every PARAMS send
+        that targets them negotiates down to the f32 v1 envelope."""
+        self._peer_wire[int(hello.sender)] = tuple(
+            str(d) for d in hello.body.get("wd", ())
+        )
 
     def _register_peer(self, idx: int, reader, writer) -> PeerState:
         peer = PeerState(idx=idx, writer=writer)
@@ -791,21 +836,79 @@ class P2PNode:
 
         await asyncio.gather(*(enqueue(p) for p in congested))
 
+    def _wire_dtype_for(self, peers, *, init: bool = False) -> str | None:
+        """Negotiate the wire precision for one PARAMS send. Reduced
+        precision requires EVERY target to have advertised it in its
+        CONNECT hello; the initial model diffusion always ships f32
+        (quantizing the common starting point would seed every node
+        with a slightly different model and break same-seed parity
+        with the f32 wire)."""
+        if init or self.wire_dtype == "f32":
+            return None
+        if all(self.wire_dtype in self._peer_wire.get(p.idx, ())
+               for p in peers):
+            return self.wire_dtype
+        return None
+
+    def _apply_error_feedback(self, params):
+        """Fold the residual of the previous int8 send into this one.
+
+        Quantization is deterministic, so adding the carried error to
+        the floating leaves BEFORE encode and recording the new
+        carried error (carried-input minus its dequantized image) is
+        exactly error-feedback compression — the wire still sees a
+        plain int8 envelope. The residual is reset whenever the leaf
+        structure changes (model swap between runs)."""
+        leaves, treedef = jax.tree.flatten(
+            jax.tree.map(np.asarray, params))
+        res = self._ef_residual
+        if res is None or len(res) != len(leaves) or any(
+            r is not None and r.shape != np.shape(leaf)
+            for r, leaf in zip(res, leaves)
+        ):
+            res = [
+                np.zeros_like(leaf, dtype=np.float32)
+                if np.issubdtype(leaf.dtype, np.floating) else None
+                for leaf in leaves
+            ]
+        carried = [
+            leaf.astype(np.float32) + r if r is not None else leaf
+            for leaf, r in zip(leaves, res)
+        ]
+        tree = jax.tree.unflatten(treedef, carried)
+        deq = jax.tree.leaves(dequantize_int8(*quantize_int8(tree)))
+        self._ef_residual = [
+            np.asarray(c, np.float32) - np.asarray(d, np.float32)
+            if r is not None else None
+            for c, d, r in zip(carried, deq, res)
+        ]
+        return tree
+
     async def _send_params(self, peers, params, contributors,
-                           weight, **body) -> None:
+                           weight, _ef: bool = False, **body) -> None:
         """Ship a weights payload to one peer or a list of peers.
 
         The Message is built ONCE for the whole target list: the
         payload encode, the content hash, the signature, and the framed
         header are all per-message-lifetime costs — every additional
         recipient costs only a queue put of the same object (the frame
-        memo makes the drain tasks reuse identical segments)."""
+        memo makes the drain tasks reuse identical segments).
+
+        ``_ef`` marks this node's OWN trained update: when the
+        negotiated wire dtype is int8, the error-feedback residual is
+        applied to it (aggregates/partials ship without EF — their
+        error has no stable per-node carrier)."""
         if isinstance(peers, PeerState):
             peers = [peers]
         if not peers:
             return
         body.setdefault("round", self.round)
-        blob = encode_parameters(params, tuple(contributors), int(weight))
+        wd = self._wire_dtype_for(peers, init=bool(body.get("init")))
+        if wd == "int8" and _ef:
+            params = self._apply_error_feedback(params)
+        blob = encode_parameters(params, tuple(contributors), int(weight),
+                                 wire_dtype=wd)
+        self.params_bytes_out += len(blob) * len(peers)
         msg = self._sign(
             Message(MsgType.PARAMS, self.idx, body, payload=blob,
                     # explicit id: PARAMS is a direct message, but
@@ -1176,7 +1279,7 @@ class P2PNode:
             )
             await self._send_params(
                 sent_to, self.learner.get_parameters(), (self.idx,),
-                n_samples,
+                n_samples, _ef=True,
             )
             await self._wait_done()
         else:  # idle / proxy: adopt whatever aggregate arrives

@@ -81,10 +81,15 @@ def make_global(x, sharding):
 def run_federation(rounds: int = 1, dataset: str = "mnist",
                    model_name: str = "mnist-mlp",
                    samples_per_node: int = 150,
-                   learning_rate: float = 0.05, seed: int = 0) -> dict:
+                   learning_rate: float = 0.05, seed: int = 0,
+                   exchange_dtype: str | None = None) -> dict:
     """One federation spanning every device of every process: node i on
     global device i, fully-connected DFL FedAvg. Every process executes
     this same function (SPMD); returns globally-agreed metrics.
+
+    ``exchange_dtype`` ("bf16") down-casts the mix contraction's
+    inputs — the same wire-precision knob the single-host builders
+    take, here shrinking the DCN (cross-host) exchange bytes.
     """
     import jax
     import jax.numpy as jnp
@@ -133,7 +138,9 @@ def run_federation(rounds: int = 1, dataset: str = "mnist",
     )
     args = [g(a) for a in (x, y, smask, nsamp, plan.mix, plan.adopt,
                            plan.trains)]
-    round_fn = jax.jit(build_round_fn(fns, epochs=1), donate_argnums=(0,))
+    ex_dt = jnp.bfloat16 if exchange_dtype in ("bf16", "int8") else None
+    round_fn = jax.jit(build_round_fn(fns, epochs=1, exchange_dtype=ex_dt),
+                       donate_argnums=(0,))
     eval_fn = jax.jit(build_eval_fn(fns))
 
     for _ in range(rounds):
@@ -208,6 +215,10 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--rounds", type=int, default=1)
     ap.add_argument("--dataset", default="mnist")
     ap.add_argument("--model", default="mnist-mlp")
+    ap.add_argument("--exchange-dtype", default=None,
+                    choices=("f32", "bf16"),
+                    help="wire precision for the demo federation's "
+                         "exchange (the config knob is wire_dtype)")
     ap.add_argument("--config", default=None,
                     help="ScenarioConfig JSON: run the FULL scenario "
                          "surface over the global mesh instead of the "
@@ -222,7 +233,8 @@ def main(argv: list[str] | None = None) -> int:
         result = run_scenario(args.config)
     else:
         result = run_federation(rounds=args.rounds, dataset=args.dataset,
-                                model_name=args.model)
+                                model_name=args.model,
+                                exchange_dtype=args.exchange_dtype)
     print("P2PFL_DCN_RESULT " + json.dumps(result), flush=True)
     return 0
 

@@ -43,11 +43,18 @@ Params = Any
 
 
 class FederatedState(struct.PyTreeNode):
-    """Whole-federation state: every leaf has a leading ``[n]`` axis."""
+    """Whole-federation state: every leaf has a leading ``[n]`` axis.
+
+    ``stale`` is the double buffer for ``exchange_overlap="staged"``:
+    ``(prev post-fit params stack, prev contribution weights [n])`` —
+    what round r ships to neighbors while round r's fit is still
+    running. ``None`` (the default) everywhere the mode is off, so
+    existing constructors, specs and tests are untouched."""
 
     states: TrainState  # stacked per-node TrainState
     alive: jax.Array  # [n] bool
     round: jax.Array  # scalar int32
+    stale: Any = None  # (params stack, weights [n]) | None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -163,6 +170,22 @@ def init_federation(
     )
 
 
+def with_staged_buffer(fed: FederatedState) -> FederatedState:
+    """Seed the staged-exchange double buffer: the CURRENT params at
+    ZERO contribution weight. The first staged round then mixes nothing
+    from neighbors (denominator = own fresh weight only) and reduces to
+    pure local training — the well-defined cold start of one-round-
+    stale gossip (tests pin this)."""
+    # copied, not aliased: the round fn donates its input state, and a
+    # buffer appearing twice in the donated tree is an XLA error
+    return fed.replace(
+        stale=(
+            jax.tree.map(jnp.copy, fed.states.params),
+            jnp.zeros((fed.alive.shape[0],), jnp.float32),
+        )
+    )
+
+
 def build_round_fn(
     fns: StepFns,
     aggregator: Aggregator | None = None,
@@ -173,6 +196,7 @@ def build_round_fn(
     attack=None,
     malicious: np.ndarray | None = None,
     update_stats: bool = False,
+    exchange_overlap: str = "off",
 ) -> Callable:
     """Build the jittable ``round_fn(fed, x, y, mask, n_samples, plan
     arrays) -> (fed, metrics)``.
@@ -225,6 +249,16 @@ def build_round_fn(
     ReputationMonitor. The sparse round builder below supports
     neither: it never materializes the full params stack, so there is
     no pre-exchange hook — robustness runs use this dense builder.
+
+    ``exchange_overlap="staged"`` double-buffers the exchange: the
+    off-diagonal mix terms read the PREVIOUS round's post-fit params
+    (``fed.stale``, seeded by :func:`with_staged_buffer`) at their then
+    contribution weights, while the self term stays this round's fresh
+    fit — one-round-stale gossip. The shipped buffer is final at round
+    start, so the exchange has no data dependence on the current fit
+    and the scheduler can hide it under the local epochs. Requires the
+    FedAvg fast path and composes with neither attack injection nor
+    trust scoring (both are defined on what a node ships THIS round).
     """
     aggregator = aggregator or FedAvg()
     fedavg_fast = type(aggregator) is FedAvg
@@ -234,6 +268,23 @@ def build_round_fn(
         and bool(np.any(malicious))
         and getattr(attack, "poisons_updates", False)
     )
+    if exchange_overlap not in ("off", "staged"):
+        raise ValueError(
+            f"unknown exchange_overlap {exchange_overlap!r}; "
+            "have ('off', 'staged')"
+        )
+    staged = exchange_overlap == "staged"
+    if staged and not fedavg_fast:
+        raise ValueError(
+            "exchange_overlap='staged' requires the FedAvg fast path — "
+            "robust aggregators score THIS round's updates"
+        )
+    if staged and (attack_active or update_stats):
+        raise ValueError(
+            "exchange_overlap='staged' composes with neither attack "
+            "injection nor trust scoring: both are defined on the "
+            "fresh update a node ships this round"
+        )
 
     def round_fn(fed: FederatedState, x, y, smask, n_samples, mix, adopt, trains):
         alive = fed.alive
@@ -260,7 +311,21 @@ def build_round_fn(
         # contribution gate: only alive *training* nodes inject models
         # (proxy/idle forward/adopt but never contribute — node.py:492-524)
         contrib = jnp.logical_and(trains, alive)
-        w = mix * n_samples.astype(jnp.float32)[None, :] * contrib[None, :]
+        w_fresh = n_samples.astype(jnp.float32) * contrib
+        new_stale = fed.stale
+        if staged:
+            # double buffer: off-diagonal terms weigh the PREVIOUS
+            # round's post-fit params at their then weights; only the
+            # self term reads this round's fresh fit. A zero stale
+            # weight (with_staged_buffer's seed, or a node dead last
+            # round) contributes nothing — round 0 is pure local SGD.
+            stale_params, stale_w = fed.stale
+            eye = jnp.eye(alive.shape[0], dtype=jnp.float32)
+            w = mix * ((1.0 - eye) * stale_w[None, :]
+                       + eye * w_fresh[None, :])
+            new_stale = (states.params, w_fresh)
+        else:
+            w = mix * w_fresh[None, :]
         if fedavg_fast:
             denom = jnp.maximum(jnp.sum(w, axis=1, keepdims=True), 1e-9)
             wn = w / denom
@@ -272,22 +337,42 @@ def build_round_fn(
                 jnp.logical_and(alive, jnp.sum(w, axis=1) > 0)
                 if identity_adopt else None
             )
+            mix_dt = exchange_dtype or jnp.float32
 
-            def leaf_mix(p):
-                mix_dt = exchange_dtype or jnp.float32
-                flat = p.reshape(p.shape[0], -1).astype(mix_dt)
-                out = jax.lax.dot(  # [n,n]@[n,d] — MXU, f32 accumulate
-                    wn.astype(mix_dt), flat,
-                    preferred_element_type=jnp.float32,
-                )
-                mixed = out.reshape(p.shape).astype(p.dtype)
+            def _keep(mixed, p):
                 if keep_early is None:
                     return mixed
                 c = keep_early.reshape(
                     (keep_early.shape[0],) + (1,) * (p.ndim - 1))
                 return jnp.where(c, mixed, p)
 
-            agg = jax.tree.map(leaf_mix, states.params)
+            if staged:
+                wn_off = wn * (1.0 - eye)
+                wn_diag = jnp.diagonal(wn)
+
+                def leaf_mix_staged(p, ps):
+                    flat_s = ps.reshape(ps.shape[0], -1).astype(mix_dt)
+                    flat_f = p.reshape(p.shape[0], -1).astype(mix_dt)
+                    out = jax.lax.dot(  # stale hops: no fit dependence
+                        wn_off.astype(mix_dt), flat_s,
+                        preferred_element_type=jnp.float32,
+                    )
+                    out = out + wn_diag[:, None] * flat_f.astype(
+                        jnp.float32)
+                    return _keep(out.reshape(p.shape).astype(p.dtype), p)
+
+                agg = jax.tree.map(leaf_mix_staged, states.params,
+                                   stale_params)
+            else:
+                def leaf_mix(p):
+                    flat = p.reshape(p.shape[0], -1).astype(mix_dt)
+                    out = jax.lax.dot(  # [n,n]@[n,d] — MXU, f32 accum
+                        wn.astype(mix_dt), flat,
+                        preferred_element_type=jnp.float32,
+                    )
+                    return _keep(out.reshape(p.shape).astype(p.dtype), p)
+
+                agg = jax.tree.map(leaf_mix, states.params)
         else:
             # wire-precision semantics for robust aggregators too: the
             # stack entering aggregation is what crosses the "wire"
@@ -341,6 +426,7 @@ def build_round_fn(
             states=states.replace(params=params),
             alive=alive,
             round=fed.round + 1,
+            stale=new_stale,
         )
         metrics = {
             "train_loss": train_metrics["loss"],  # [n]
@@ -364,6 +450,7 @@ def build_round_fn_sparse(
     mesh,
     epochs: int = 1,
     exchange_dtype: Any | None = None,
+    exchange_overlap: str = "off",
 ) -> Callable:
     """The sparse-topology round: O(degree) ``ppermute`` hops over ICI
     instead of the dense all-gather einsum.
@@ -394,10 +481,19 @@ def build_round_fn_sparse(
             f"sparse round needs one node per mesh slot: "
             f"{topology.n} nodes vs {mesh.size} devices"
         )
+    if exchange_overlap not in ("off", "staged"):
+        raise ValueError(
+            f"unknown exchange_overlap {exchange_overlap!r}; "
+            "have ('off', 'staged')"
+        )
+    staged = exchange_overlap == "staged"
 
     Pn = PartitionSpec(NODES_AXIS)
     Pr = PartitionSpec()
-    fed_spec = FederatedState(states=Pn, alive=Pn, round=Pr)
+    fed_spec = FederatedState(
+        states=Pn, alive=Pn, round=Pr,
+        stale=(Pn, Pn) if staged else None,
+    )
 
     def round_body(fed: FederatedState, x, y, smask, n_samples, mix, adopt, trains):
         # every block arrives with a leading node axis of size 1
@@ -411,10 +507,24 @@ def build_round_fn_sparse(
         contrib = jnp.logical_and(trains, alive)
         my_w = (n_samples.astype(jnp.float32) * contrib)[0]
         local = jax.tree.map(lambda p: p[0], states.params)
-        agg, total = neighbor_exchange(
-            local, my_w, mix[0], topology, NODES_AXIS,
-            exchange_dtype=exchange_dtype,
-        )
+        if staged:
+            # ship the PREVIOUS round's post-fit buffer on the hops —
+            # ready at round start, so the ppermutes need not wait for
+            # this round's fit (see neighbor_exchange)
+            stale_p, stale_w = fed.stale
+            agg, total = neighbor_exchange(
+                local, my_w, mix[0], topology, NODES_AXIS,
+                exchange_dtype=exchange_dtype,
+                stale_params=jax.tree.map(lambda p: p[0], stale_p),
+                stale_weight=stale_w[0],
+            )
+            new_stale = (states.params, my_w[None])
+        else:
+            agg, total = neighbor_exchange(
+                local, my_w, mix[0], topology, NODES_AXIS,
+                exchange_dtype=exchange_dtype,
+            )
+            new_stale = fed.stale
         keep = jnp.logical_and(alive[0], total > 0)
         params = jax.tree.map(
             lambda a, p: jnp.where(keep, a.astype(p.dtype), p[0])[None],
@@ -424,6 +534,7 @@ def build_round_fn_sparse(
             states=states.replace(params=params),
             alive=alive,
             round=fed.round + 1,
+            stale=new_stale,
         )
         metrics = {"train_loss": train_metrics["loss"], "alive": alive}
         return fed, metrics

@@ -59,6 +59,8 @@ def neighbor_exchange(
     topology: Topology,
     axis_name: str = NODES_AXIS,
     exchange_dtype: Any | None = None,
+    stale_params: Any | None = None,
+    stale_weight: jnp.ndarray | None = None,
 ) -> tuple[Any, jnp.ndarray]:
     """Weighted neighborhood average via ``ppermute`` — for use inside
     ``shard_map`` with one node per mesh slot.
@@ -87,22 +89,41 @@ def neighbor_exchange(
     holds for ``exchange_dtype=None`` (the default): with a wire dtype
     the two schedules still agree on what crosses the wire but differ
     in weight rounding and accumulation order.
+
+    ``stale_params``/``stale_weight`` switch the hops to DOUBLE-
+    BUFFERED (staged) mode: what crosses the wire is the PREVIOUS
+    round's post-fit tree at its then contribution weight, while the
+    self contribution stays this round's fresh ``params``/``my_weight``
+    — one-round-stale gossip. The point is scheduling freedom: the
+    shipped buffer is already final when the round starts, so XLA can
+    hoist the ppermute sends before/under the local fit instead of
+    fencing them behind it (exchange_overlap="staged",
+    docs/perf.md §11). A zero ``stale_weight`` round (the seeded
+    buffer) degenerates to pure local training.
     """
     n = topology.n
     idx = jax.lax.axis_index(axis_name)
     w_self = row[idx] * my_weight
-    wire = (
-        params if exchange_dtype is None
-        else jax.tree.map(lambda p: p.astype(exchange_dtype), params)
-    )
+
+    def cast(tree):
+        return (
+            tree if exchange_dtype is None
+            else jax.tree.map(lambda p: p.astype(exchange_dtype), tree)
+        )
+
+    wire = cast(params)
+    if stale_params is not None:
+        hop_tree, hop_w = cast(stale_params), stale_weight
+    else:
+        hop_tree, hop_w = wire, my_weight
     acc = jax.tree.map(lambda p: p.astype(jnp.float32) * w_self, wire)
     total = w_self
     for k in edge_offsets(topology):
         perm = [(i, (i + k) % n) for i in range(n)]  # src -> dst
         shifted = jax.tree.map(
-            lambda p: jax.lax.ppermute(p, axis_name, perm), wire
+            lambda p: jax.lax.ppermute(p, axis_name, perm), hop_tree
         )
-        w_recv = jax.lax.ppermute(my_weight, axis_name, perm)
+        w_recv = jax.lax.ppermute(hop_w, axis_name, perm)
         sender = (idx - k) % n
         wk = row[sender] * w_recv
         acc = jax.tree.map(

@@ -1,0 +1,180 @@
+"""Overlap + wire-dtype A/B repro (round 10: hide the wire under the fit).
+
+Reproduces the two bench._phase_comm experiments at laptop scale, with
+the same interleaved min-of-pairs discipline (bench._ab_interleaved):
+
+- SPMD plane: ``exchange_overlap`` off vs staged on a bench._build
+  federation — steady-state round time per arm, post-warm-up recompile
+  count (must stay 0), and optionally rounds-to-80 to pin convergence.
+  The bench phase runs this at the 64-node femnist-cnn headline; the
+  defaults here are sized for a CPU repro.
+- socket plane: ``wire_dtype`` f32 vs each reduced dtype on the
+  in-process simulation — round time, payload bytes/round (the
+  ``params_bytes_out`` counter over the round count), and same-seed
+  accuracy, which must be identical for bf16 at this scale.
+
+Usage: python scripts/exp_overlap.py [--plane spmd|socket|both]
+         [--n 8] [--samples-per-node 150] [--batch-size 48] [--pairs 2]
+         [--rounds-to-80] [--socket-nodes 8] [--rounds 3] [--uncapped]
+         [--wire-dtypes f32,bf16,int8]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import re
+import sys
+from pathlib import Path
+
+_REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(_REPO))
+
+# CPU backend unless the caller forces otherwise: the socket plane's
+# asyncio nodes must not fight for a chip, and the SPMD repro is about
+# schedule shape, not device speed (bench's comm phase measures on the
+# real accelerator)
+flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+               os.environ.get("XLA_FLAGS", "")).strip()
+os.environ["XLA_FLAGS"] = flags
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import bench  # noqa: E402
+
+
+def run_spmd(n: int, samples_per_node: int, batch_size: int, pairs: int,
+             rounds_to_80: bool, dataset: str, model: str) -> None:
+    from p2pfl_tpu.obs import trace as obs_trace
+
+    obs_trace.install_xla_listener()
+    kw = dict(dataset=dataset, model=model,
+              samples_per_node=samples_per_node, batch_size=batch_size)
+    run_off = bench._build(n, exchange_overlap="off", **kw)
+    run_st = bench._build(n, exchange_overlap="staged", **kw)
+
+    def arm(run):
+        return lambda: {"round_s": bench._time_chained(run, k=5, reps=1)}
+
+    best_off, best_st = bench._ab_interleaved(arm(run_off), arm(run_st),
+                                              pairs=pairs)
+    obs_trace.reset_xla_counters()
+    bench._time_chained(run_off, k=2, reps=1)
+    bench._time_chained(run_st, k=2, reps=1)
+    off_s = best_off and best_off["round_s"]
+    st_s = best_st and best_st["round_s"]
+    print(f"spmd n={n}: off_round_s={off_s and round(off_s, 4)} "
+          f"staged_round_s={st_s and round(st_s, 4)} "
+          f"delta={round(100 * (st_s - off_s) / off_s, 1) if off_s and st_s else None}% "
+          f"steady_state_recompiles={obs_trace.xla_recompiles()}",
+          flush=True)
+
+    if rounds_to_80:
+        run_off["fed"] = run_st["fed"] = None
+        r80_off, _, fin_off, _ = bench._accuracy_run(
+            run_off, target=0.80, max_rounds=30, measure_seconds=False)
+        r80_st, _, fin_st, _ = bench._accuracy_run(
+            run_st, target=0.80, max_rounds=30, measure_seconds=False)
+        print(f"spmd rounds_to_80: off={r80_off} staged={r80_st} "
+              f"final_acc off={fin_off:.4f} staged={fin_st:.4f}",
+              flush=True)
+
+
+def run_socket(n: int, rounds: int, uncapped: bool, pairs: int,
+               wire_dtypes: list[str]) -> None:
+    from p2pfl_tpu.config.schema import (
+        DataConfig,
+        ProtocolConfig,
+        ScenarioConfig,
+        TrainingConfig,
+    )
+    from p2pfl_tpu.p2p.launch import run_simulation
+
+    def cfg(wd):
+        return ScenarioConfig(
+            name="expcomm", n_nodes=n, topology="fully",
+            data=DataConfig(dataset="mnist", samples_per_node=60),
+            training=TrainingConfig(rounds=rounds, epochs_per_round=1,
+                                    learning_rate=0.05),
+            protocol=ProtocolConfig(
+                heartbeat_period_s=0.5, aggregation_timeout_s=60.0,
+                vote_timeout_s=10.0,
+                train_set_size=n if uncapped else min(8, n),
+                gossip_fanout=min(12, n - 1)),
+            wire_dtype=wd,
+        )
+
+    def arm(wd):
+        def run():
+            out = run_simulation(cfg(wd), timeout=280)
+            out["payload_per_round"] = round(
+                (out.get("params_bytes_out") or 0)
+                / max(out.get("rounds") or 1, 1))
+            return out
+        return run
+
+    base = None
+    for wd in wire_dtypes:
+        if wd == "f32" and base is None and len(wire_dtypes) > 1:
+            continue  # measured interleaved against each reduced dtype
+        if wd == "f32":
+            best, _ = bench._ab_interleaved(arm("f32"), lambda: {},
+                                            pairs=pairs)
+            reduced = None
+        else:
+            best_f32, best = bench._ab_interleaved(arm("f32"), arm(wd),
+                                                   pairs=pairs)
+            base = base or best_f32
+            reduced = best
+        ref, got = (base, reduced) if reduced else (best, None)
+        if ref:
+            print(f"socket n={n} f32: round_s={ref.get('round_s')} "
+                  f"payload/round={ref.get('payload_per_round')} "
+                  f"acc={ref.get('mean_accuracy')} "
+                  f"recompiles={ref.get('xla_recompiles')}", flush=True)
+        if got:
+            ratio = (round(ref["payload_per_round"]
+                           / got["payload_per_round"], 2)
+                     if ref and ref.get("payload_per_round")
+                     and got.get("payload_per_round") else None)
+            print(f"socket n={n} {wd}: round_s={got.get('round_s')} "
+                  f"payload/round={got.get('payload_per_round')} "
+                  f"(f32/{wd} = {ratio}x) "
+                  f"acc={got.get('mean_accuracy')} "
+                  f"recompiles={got.get('xla_recompiles')}", flush=True)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--plane", choices=("spmd", "socket", "both"),
+                    default="both")
+    ap.add_argument("--n", type=int, default=8,
+                    help="SPMD federation size (bench comm phase: 64)")
+    ap.add_argument("--samples-per-node", type=int, default=150)
+    ap.add_argument("--batch-size", type=int, default=48)
+    ap.add_argument("--dataset", default="femnist")
+    ap.add_argument("--model", default="femnist-cnn",
+                    help="mnist-mlp keeps the CPU repro fast; the bench "
+                         "comm phase measures the real femnist-cnn")
+    ap.add_argument("--pairs", type=int, default=2)
+    ap.add_argument("--rounds-to-80", action="store_true",
+                    help="also pin convergence per overlap arm")
+    ap.add_argument("--socket-nodes", type=int, default=8,
+                    help="socket federation size (bench comm phase: 24)")
+    ap.add_argument("--rounds", type=int, default=3)
+    ap.add_argument("--uncapped", action="store_true",
+                    help="train_set_size = n (every node trains and "
+                         "gossips — the payload-bound config)")
+    ap.add_argument("--wire-dtypes", default="f32,bf16",
+                    help="comma list from f32,bf16,int8")
+    args = ap.parse_args()
+
+    if args.plane in ("spmd", "both"):
+        run_spmd(args.n, args.samples_per_node, args.batch_size,
+                 args.pairs, args.rounds_to_80, args.dataset, args.model)
+    if args.plane in ("socket", "both"):
+        run_socket(args.socket_nodes, args.rounds, args.uncapped,
+                   args.pairs, args.wire_dtypes.split(","))
+
+
+if __name__ == "__main__":
+    main()

@@ -29,7 +29,7 @@ def test_zero_budget_still_emits_parseable_json():
     # with zero budget (t_end == t_start, remaining negative
     # everywhere), every phase is explicitly accounted as skipped
     assert set(out["skipped_phases"]) == {
-        "headline", "cifar16", "cpu8", "socket24", "socket_mp",
+        "headline", "cifar16", "cpu8", "socket24", "comm", "socket_mp",
         "obs", "robust", "vit32"
     }
 
@@ -83,6 +83,66 @@ def test_obs_phase_dry_run_emits_key_plan():
     # every planned key must be registered (and, via
     # check_bench_keys, documented)
     assert planned <= set(bench.BENCH_KEYS)
+
+
+def test_comm_phase_dry_run_emits_key_plan():
+    """P2PFL_COMM_DRY=1: the comm phase must emit its planned key list
+    as one parseable part without touching jax — the round-10 analog
+    of the obs dry-run hook."""
+    env = dict(os.environ, P2PFL_COMM_DRY="1")
+    code = (f"import sys; sys.path.insert(0, {str(REPO)!r})\n"
+            "import bench; bench._phase_comm()\n")
+    res = subprocess.run([sys.executable, "-c", code],
+                         capture_output=True, text=True, timeout=60,
+                         env=env, cwd=REPO)
+    assert res.returncode == 0, res.stderr[-500:]
+    sys.path.insert(0, str(REPO))
+    import bench
+
+    parts = [json.loads(line[len(bench._PART_TAG):])
+             for line in res.stdout.splitlines()
+             if line.startswith(bench._PART_TAG)]
+    assert len(parts) == 1 and parts[0]["comm_dry"] is True
+    planned = set(parts[0]["comm_keys"])
+    assert {"wire_payload_bytes_per_round", "wire_payload_reduction",
+            "wire_bf16_round_s_24node_uncapped", "overlap_round_s",
+            "overlap_rounds_to_80pct",
+            "overlap_xla_recompiles"} <= planned
+    assert planned <= set(bench.BENCH_KEYS)
+
+
+def test_ab_interleaved_orders_runs_and_picks_min():
+    """_ab_interleaved: strict A,B,A,B interleave, min-of-pairs per
+    arm, None/keyless runs dropped at selection, on_run sees every
+    run."""
+    sys.path.insert(0, str(REPO))
+    import bench
+
+    calls = []
+    a_results = iter([{"round_s": 3.0}, {"round_s": 2.0}])
+    b_results = iter([None, {"round_s": 5.0}])
+
+    def run_a():
+        calls.append("a")
+        return next(a_results)
+
+    def run_b():
+        calls.append("b")
+        return next(b_results)
+
+    seen = []
+    best_a, best_b = bench._ab_interleaved(
+        run_a, run_b, pairs=2,
+        on_run=lambda tag, i, r: seen.append((tag, i)))
+    assert calls == ["a", "b", "a", "b"]
+    assert seen == [("a", 0), ("b", 0), ("a", 1), ("b", 1)]
+    assert best_a == {"round_s": 2.0}
+    assert best_b == {"round_s": 5.0}
+
+    # an arm whose every run lacks the key selects None, not a crash
+    best_a, best_b = bench._ab_interleaved(
+        lambda: {"other": 1}, lambda: {"round_s": 1.0}, pairs=1)
+    assert best_a is None and best_b == {"round_s": 1.0}
 
 
 def test_bench_keys_registry_in_sync_with_docs():

@@ -1,0 +1,162 @@
+"""Double-buffered (staged) neighbor exchange semantics.
+
+``exchange_overlap="staged"`` ships the PREVIOUS round's post-fit
+params at their then contribution weights while the self term stays
+fresh (one-round-stale gossip, parallel/federated.py). These tests pin
+the mode's defining behaviors on the dense plane (sparse/dense staged
+parity lives in test_transport_sparse.py):
+
+- the seeded buffer (zero weight) makes round 0 EXACTLY pure local
+  training;
+- later rounds really mix stale state (differ from eager exchange);
+- the mode composes only with the FedAvg fast path — robust
+  aggregators, attack injection, and trust scoring refuse loudly;
+- the config knobs validate, and a Scenario threads them end to end.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from p2pfl_tpu.config.schema import DataConfig, ScenarioConfig
+from p2pfl_tpu.datasets import FederatedDataset
+from p2pfl_tpu.learning.learner import make_step_fns
+from p2pfl_tpu.models import get_model
+from p2pfl_tpu.parallel.federated import (
+    build_round_fn,
+    init_federation,
+    make_round_plan,
+    with_staged_buffer,
+)
+from p2pfl_tpu.parallel.transport import MeshTransport
+from p2pfl_tpu.topology.topology import generate_topology
+
+N = 4
+
+
+@pytest.fixture(scope="module")
+def setup():
+    ds = FederatedDataset.make(
+        DataConfig(dataset="mnist", samples_per_node=60,
+                   surrogate_profile="easy"), N
+    )
+    x, y, smask, nsamp = ds.stacked()
+    fns = make_step_fns(get_model("mnist-mlp"), learning_rate=0.05,
+                        batch_size=32)
+    tr = MeshTransport(N)
+    data = tuple(
+        tr.put_stacked(jnp.asarray(a)) for a in (x, y, smask, nsamp)
+    )
+    return fns, tr, data
+
+
+def _args(tr, plan, mix=None):
+    return (
+        tr.put_stacked(jnp.asarray(plan.mix if mix is None else mix)),
+        tr.put_stacked(jnp.asarray(plan.adopt)),
+        tr.put_stacked(jnp.asarray(plan.trains)),
+    )
+
+
+def _run(fns, tr, data, *, overlap, rounds=1, mix=None):
+    topo = generate_topology("ring", N)
+    plan = make_round_plan(topo, ["aggregator"] * N, "DFL")
+    fed0 = init_federation(fns, data[0][0, :1], N)
+    if overlap == "staged":
+        fed0 = with_staged_buffer(fed0)
+    fed = tr.put_stacked(fed0)
+    round_fn = tr.compile_round(
+        build_round_fn(fns, epochs=1, exchange_overlap=overlap)
+    )
+    for _ in range(rounds):
+        fed, metrics = round_fn(fed, *data, *_args(tr, plan, mix))
+    return jax.tree.map(np.asarray, fed), metrics
+
+
+def test_staged_round0_is_pure_local_training(setup):
+    """The seeded stale buffer carries ZERO weight, so the first
+    staged round must equal an exchange-free round — the same program
+    with an identity mixing matrix (each node keeps only itself)."""
+    fns, tr, data = setup
+    staged, _ = _run(fns, tr, data, overlap="staged")
+    local, _ = _run(fns, tr, data, overlap="off",
+                    mix=np.eye(N, dtype=np.float32))
+    for pa, pb in zip(
+        jax.tree.leaves(staged.states.params),
+        jax.tree.leaves(local.states.params),
+    ):
+        np.testing.assert_allclose(pa, pb, rtol=1e-5, atol=1e-6)
+
+
+def test_staged_differs_from_eager_after_round0(setup):
+    """From round 1 on, staged mixes ONE-ROUND-STALE neighbor params —
+    the trajectories must measurably diverge from the eager exchange,
+    and the double buffer must hold the post-fit params at nonzero
+    weight."""
+    fns, tr, data = setup
+    staged, _ = _run(fns, tr, data, overlap="staged", rounds=2)
+    eager, _ = _run(fns, tr, data, overlap="off", rounds=2)
+    delta = max(
+        float(np.max(np.abs(pa - pb)))
+        for pa, pb in zip(
+            jax.tree.leaves(staged.states.params),
+            jax.tree.leaves(eager.states.params),
+        )
+    )
+    assert delta > 1e-4, "staged exchange behaved like the eager one"
+    assert staged.stale is not None
+    assert np.all(np.asarray(staged.stale[1]) > 0)
+    # the off-mode state carries no buffer at all
+    assert eager.stale is None
+
+
+def test_staged_refuses_non_fedavg_paths(setup):
+    from p2pfl_tpu.adversary import AttackSpec
+    from p2pfl_tpu.core.aggregators import Krum
+
+    fns, _, _ = setup
+    with pytest.raises(ValueError, match="FedAvg"):
+        build_round_fn(fns, aggregator=Krum(f=1, m=2),
+                       exchange_overlap="staged")
+    with pytest.raises(ValueError, match="trust scoring"):
+        build_round_fn(fns, update_stats=True, exchange_overlap="staged")
+    mal = np.zeros(N, bool)
+    mal[1] = True
+    with pytest.raises(ValueError, match="attack"):
+        build_round_fn(fns, attack=AttackSpec(kind="signflip", scale=10.0),
+                       malicious=mal, exchange_overlap="staged")
+    with pytest.raises(ValueError, match="exchange_overlap"):
+        build_round_fn(fns, exchange_overlap="eager")
+
+
+def test_config_knobs_validate():
+    data = DataConfig(dataset="mnist", samples_per_node=50)
+    with pytest.raises(ValueError, match="wire_dtype"):
+        ScenarioConfig(name="bad", n_nodes=4, data=data, wire_dtype="fp4")
+    with pytest.raises(ValueError, match="exchange_overlap"):
+        ScenarioConfig(name="bad", n_nodes=4, data=data,
+                       exchange_overlap="eager")
+    cfg = ScenarioConfig(name="ok", n_nodes=4, data=data,
+                         wire_dtype="bf16", exchange_overlap="staged")
+    assert cfg.wire_dtype == "bf16"
+    assert cfg.exchange_overlap == "staged"
+
+
+def test_scenario_threads_overlap_and_wire_dtype():
+    """End to end through Scenario: ring topology (sparse transport)
+    with staged overlap + bf16 wire runs and keeps the double buffer
+    in the federation state."""
+    from p2pfl_tpu.federation.scenario import Scenario
+
+    cfg = ScenarioConfig(
+        name="staged-ring", n_nodes=8, topology="ring",
+        data=DataConfig(dataset="mnist", samples_per_node=100),
+        wire_dtype="bf16", exchange_overlap="staged",
+    )
+    sc = Scenario(cfg)
+    assert sc.sparse_transport
+    res = sc.run(rounds=2)
+    assert np.isfinite(res.final_accuracy)
+    assert sc.fed.stale is not None
+    assert np.all(np.asarray(sc.fed.stale[1]) > 0)

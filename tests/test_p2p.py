@@ -10,6 +10,7 @@ DFL federation and a CFL server federation over real sockets.
 import asyncio
 import struct
 
+import jax
 import msgpack
 import numpy as np
 import pytest
@@ -266,12 +267,14 @@ _PROTO = ProtocolConfig(heartbeat_period_s=0.2, aggregation_timeout_s=20.0,
 
 
 async def _run_federation(roles, rounds=2, start_node=0, proto=_PROTO,
-                          samples=150, timeout=120, netem=None):
+                          samples=150, timeout=120, netem=None,
+                          wire_dtypes=None):
     n = len(roles)
     fed, learners = _make_learners(n, samples=samples)
     nodes = [
         P2PNode(i, learners[i], role=roles[i], n_nodes=n, protocol=proto,
-                gossip_period_s=0.02, netem=netem)
+                gossip_period_s=0.02, netem=netem,
+                wire_dtype=wire_dtypes[i] if wire_dtypes else "f32")
         for i in range(n)
     ]
     for node in nodes:
@@ -860,3 +863,150 @@ def test_relay_crosses_severed_link_at_n3():
                 await node.stop()
 
     asyncio.run(main())
+
+
+def test_wire_dtype_bf16_federation_converges():
+    """All peers on wire_dtype=bf16: the federation completes, every
+    node agrees on the aggregate (bf16 rounding is identical for every
+    receiver of a given blob), and the payload counter records fewer
+    bytes than the same federation at f32."""
+
+    async def main():
+        fed, nodes = await _run_federation(["aggregator"] * 3,
+                                           wire_dtypes=["bf16"] * 3)
+        try:
+            assert all(node.round == 2 for node in nodes)
+            p0 = np.asarray(
+                nodes[0].learner.get_parameters()["params"]["Dense_2"]["kernel"]
+            )
+            p2 = np.asarray(
+                nodes[2].learner.get_parameters()["params"]["Dense_2"]["kernel"]
+            )
+            # each node folds its OWN model at f32 with neighbors'
+            # bf16-rounded copies, so cross-node aggregates agree only
+            # to bf16 rounding, not bit-exactly as at f32
+            np.testing.assert_allclose(p0, p2, rtol=2e-2, atol=2e-3)
+            assert nodes[1].learner.evaluate()["accuracy"] > 0.5
+            bf16_bytes = sum(n.params_bytes_out for n in nodes)
+        finally:
+            for node in nodes:
+                await node.stop()
+
+        fed, nodes = await _run_federation(["aggregator"] * 3)
+        try:
+            f32_bytes = sum(n.params_bytes_out for n in nodes)
+        finally:
+            for node in nodes:
+                await node.stop()
+        # a hard 2x is NOT expected here: the init-diffusion loop
+        # re-ships f32 weights every 0.02 s gossip tick until every
+        # peer acks, which dominates a 3-node 2-round run. The >=1.9x
+        # payload gate lives at the bench's 24-node uncapped config
+        # (wire_payload_reduction), where round traffic dominates.
+        assert bf16_bytes < 0.95 * f32_bytes, (bf16_bytes, f32_bytes)
+
+    asyncio.run(main())
+
+
+def test_mixed_wire_config_peers_interoperate():
+    """A bf16-configured node among f32-configured peers: every node
+    in this build ADVERTISES the full decode capability in its CONNECT
+    hello, so the bf16 sender may ship reduced payloads and everyone
+    still converges to the same aggregate."""
+
+    async def main():
+        fed, nodes = await _run_federation(
+            ["aggregator"] * 3, wire_dtypes=["bf16", "f32", "f32"])
+        try:
+            assert all(node.round == 2 for node in nodes)
+            p0 = np.asarray(
+                nodes[0].learner.get_parameters()["params"]["Dense_2"]["kernel"]
+            )
+            p1 = np.asarray(
+                nodes[1].learner.get_parameters()["params"]["Dense_2"]["kernel"]
+            )
+            np.testing.assert_allclose(p0, p1, rtol=2e-2, atol=2e-3)
+        finally:
+            for node in nodes:
+                await node.stop()
+
+    asyncio.run(main())
+
+
+def test_wire_dtype_negotiation_and_legacy_fallback():
+    """The CONNECT-hello negotiation pins both directions of skew: a
+    peer advertising the capability gets the reduced dtype, a peer
+    whose hello predates the "wd" field (empty capability set) forces
+    the f32 fallback, and the init diffusion always rides f32."""
+
+    async def main():
+        fed, nodes = await _run_federation(["aggregator"] * 3,
+                                           wire_dtypes=["bf16"] * 3)
+        try:
+            n0 = nodes[0]
+            peers = list(n0.peers.values())
+            assert len(peers) == 2
+            assert n0._wire_dtype_for(peers) == "bf16"
+            assert n0._wire_dtype_for(peers, init=True) is None
+            # legacy peer: hello carried no "wd" -> empty capability
+            n0._peer_wire[peers[0].idx] = ()
+            assert n0._wire_dtype_for(peers) is None
+            assert n0._wire_dtype_for([peers[1]]) == "bf16"
+            assert n0.params_bytes_out > 0
+        finally:
+            for node in nodes:
+                await node.stop()
+
+    asyncio.run(main())
+
+
+def test_wire_dtype_int8_federation_converges():
+    """All peers on wire_dtype=int8: the federation still completes
+    and the trainers hold an error-feedback residual afterwards (only
+    the trainer->aggregator own-model send runs error feedback — an
+    aggregator's own model never crosses the wire)."""
+
+    async def main():
+        fed, nodes = await _run_federation(
+            ["aggregator", "trainer", "trainer"],
+            wire_dtypes=["int8"] * 3)
+        try:
+            assert all(node.round == 2 for node in nodes)
+            assert nodes[1].learner.evaluate()["accuracy"] > 0.5
+            assert any(nd._ef_residual is not None for nd in nodes), \
+                "no node exercised the EF send path"
+        finally:
+            for node in nodes:
+                await node.stop()
+
+    asyncio.run(main())
+
+
+def test_int8_error_feedback_residual_is_exact():
+    """_apply_error_feedback is deterministic error feedback: the held
+    residual after a send is exactly (carried - dequantize(quantize(
+    carried))), and the next send carries it back in."""
+    from p2pfl_tpu.core.serialize import dequantize_int8, quantize_int8
+
+    fed, learners = _make_learners(1)
+    node = P2PNode(0, learners[0], role="aggregator", n_nodes=1,
+                   protocol=_PROTO, wire_dtype="int8")
+    params = {"w": np.linspace(-1.0, 1.0, 7, dtype=np.float32),
+              "step": np.asarray(3, np.int32)}
+
+    t1 = node._apply_error_feedback(params)
+    # first send: zero residual seeded, carried == params
+    for got, want in zip(jax.tree.leaves(t1), jax.tree.leaves(params)):
+        np.testing.assert_array_equal(np.asarray(got), want)
+    expect_res = np.asarray(params["w"]) - np.asarray(
+        dequantize_int8(*quantize_int8(t1))["w"])
+    got_res = [r for r in node._ef_residual if r is not None]
+    assert len(got_res) == 1  # only the float leaf carries a residual
+    np.testing.assert_allclose(got_res[0], expect_res, atol=1e-7)
+
+    # second send folds the residual into the carried tree
+    t2 = node._apply_error_feedback(params)
+    np.testing.assert_allclose(np.asarray(t2["w"]),
+                               params["w"] + expect_res, atol=1e-7)
+    # non-float leaf untouched both times
+    assert int(t2["step"]) == 3

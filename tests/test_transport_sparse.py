@@ -22,6 +22,7 @@ from p2pfl_tpu.parallel.federated import (
     build_round_fn_sparse,
     init_federation,
     make_round_plan,
+    with_staged_buffer,
 )
 from p2pfl_tpu.parallel.transport import MeshTransport, edge_offsets
 from p2pfl_tpu.topology.topology import generate_topology
@@ -60,14 +61,22 @@ def _plan_args(tr, plan):
     )
 
 
-def _run_both(fns, tr, data, topo, alive=None, rounds=2):
+def _run_both(fns, tr, data, topo, alive=None, rounds=2,
+              exchange_dtype=None, exchange_overlap="off"):
     plan = make_round_plan(topo, ["aggregator"] * N, "DFL")
     outs = []
     for build in (
-        lambda: build_round_fn(fns, epochs=1),
-        lambda: build_round_fn_sparse(fns, topo, tr.mesh, epochs=1),
+        lambda: build_round_fn(fns, epochs=1,
+                               exchange_dtype=exchange_dtype,
+                               exchange_overlap=exchange_overlap),
+        lambda: build_round_fn_sparse(fns, topo, tr.mesh, epochs=1,
+                                      exchange_dtype=exchange_dtype,
+                                      exchange_overlap=exchange_overlap),
     ):
-        fed = tr.put_stacked(init_federation(fns, data[0][0, :1], N))
+        fed0 = init_federation(fns, data[0][0, :1], N)
+        if exchange_overlap == "staged":
+            fed0 = with_staged_buffer(fed0)
+        fed = tr.put_stacked(fed0)
         if alive is not None:
             fed = fed.replace(alive=tr.put_stacked(jnp.asarray(alive)))
         round_fn = tr.compile_round(build())
@@ -129,6 +138,47 @@ def test_parity_with_dead_node(setup):
         jax.tree.leaves(init.states.params), jax.tree.leaves(fa.states.params)
     ):
         np.testing.assert_array_equal(np.asarray(p0)[3], pa[3])
+
+
+def test_parity_ring_bf16_wire(setup):
+    """exchange_dtype=bf16, same topology/seed: the sparse ppermute
+    hops and the dense einsum must apply the SAME wire rounding — both
+    cast every tree entering the aggregation (self contribution
+    included) to bf16 and accumulate in f32. Tolerance is wider than
+    the f32 parity tests: past the shared wire cast the two schedules
+    still differ in weight rounding and summation order, and bf16's
+    epsilon (~2^-8) scales that benign drift up with it."""
+    fns, tr, data = setup
+    (fa, ma), (fb, mb) = _run_both(
+        fns, tr, data, generate_topology("ring", N), rounds=1,
+        exchange_dtype=jnp.bfloat16)
+    for pa, pb in zip(
+        jax.tree.leaves(fa.states.params), jax.tree.leaves(fb.states.params)
+    ):
+        np.testing.assert_allclose(pa, pb, rtol=8e-3, atol=8e-3)
+    np.testing.assert_array_equal(fa.alive, fb.alive)
+    np.testing.assert_allclose(
+        np.asarray(ma["train_loss"]), np.asarray(mb["train_loss"]),
+        rtol=1e-4,
+    )
+
+
+def test_parity_ring_staged_overlap(setup):
+    """exchange_overlap=staged: both schedules ship the previous
+    round's post-fit tree at its then weight while keeping the self
+    contribution fresh — dense (off-diagonal stale contraction) and
+    sparse (stale ppermute hops) must stay in parity through the
+    seeded round AND a round that actually mixes stale state."""
+    fns, tr, data = setup
+    (fa, _), (fb, _) = _run_both(
+        fns, tr, data, generate_topology("ring", N), rounds=2,
+        exchange_overlap="staged")
+    _assert_fed_close(fa, fb)
+    # the double buffer advanced in both: stale weights are the
+    # contribution weights of the round just run, not the seed zeros
+    for f in (fa, fb):
+        assert f.stale is not None
+        assert np.all(np.asarray(f.stale[1]) > 0)
 
 
 def test_scenario_auto_selects_sparse():

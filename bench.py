@@ -984,6 +984,18 @@ _COMM_KEYS = (
     "overlap_xla_recompiles",
 )
 
+# keys the elastic phase (round 11: churn + straggler survival) emits;
+# static so BENCH_KEYS and the P2PFL_ELASTIC_DRY plan stay authoritative
+_ELASTIC_KEYS = (
+    "elastic_sync_round_s", "elastic_async_round_s",
+    "elastic_sync_wall_s", "elastic_async_wall_s",
+    "elastic_sync_accuracy", "elastic_async_accuracy",
+    "elastic_async_speedup", "elastic_churn",
+    "elastic_spmd_rounds_to_target", "elastic_spmd_rounds_to_target_weighted",
+    "elastic_spmd_final_acc", "elastic_spmd_final_acc_weighted",
+    "elastic_spmd_target_accuracy",
+)
+
 # Authoritative registry of every top-level key bench can emit.
 # scripts/check_bench_keys.py asserts each one is documented in
 # docs/perf.md (§10 key reference) and that no emission site uses a
@@ -1025,6 +1037,8 @@ BENCH_KEYS = (
     *("obs_attr_" + s.replace(".", "_") + "_s" for s in _OBS_ATTR_SPANS),
     # comm (round 10: overlap + wire-dtype A/Bs)
     "comm_dry", "comm_keys", *_COMM_KEYS,
+    # elastic (round 11: churn + straggler survival)
+    "elastic_dry", "elastic_keys", *_ELASTIC_KEYS,
     # orchestration-test hook
     "selftest_key",
 )
@@ -1518,6 +1532,160 @@ print("BENCH_COMMWIRE " + json.dumps({"f32": f32, "bf16": bf16}),
               flush=True)
 
 
+def _phase_elastic() -> None:
+    """Elastic federation (round 11: live join/leave + staleness-
+    weighted async aggregation): time-to-accuracy under 20% churn and
+    4x straggler skew, on both planes.
+
+    (a) socket — the 24-node uncapped simulation scenario with
+        ``churn_fraction=0.2`` (crash at rounds/3, live re-join via the
+        STATE_SYNC handshake at 2*rounds/3) and 25% of nodes at
+        ``fit_slowdown=4``, run with the SYNC close rule (full train-set
+        coverage or aggregation timeout) vs the ASYNC one
+        (``min_received`` quorum + staleness-discounted late folds),
+        interleaved via ``_ab_interleaved``. The headline is wall-clock
+        to the same round count at comparable accuracy: sync pays the
+        aggregation timeout for every crashed/straggling contributor,
+        async closes at quorum. CPU subprocess like _socket24 (asyncio
+        nodes cannot share the bench chip).
+    (b) SPMD — the same elastic config driven through ``Scenario``:
+        scripted crash/join faults (the join copies the leader row —
+        the plane's STATE_SYNC twin) and the straggler cohort modeled
+        as a static staleness column on the mixing matrix
+        (``staleness_scale``, parallel/federated.py). Reports
+        rounds-to-target with the staleness weighting off vs on; this
+        arm pins plane parity, not a speedup — SPMD is lockstep, so
+        expect a null-to-negative result here (perf.md §12).
+
+    ``P2PFL_ELASTIC_DRY=1`` emits the key plan without touching the
+    accelerator — the orchestration test's smoke hook."""
+    if os.environ.get("P2PFL_ELASTIC_DRY") == "1":
+        _part({"elastic_dry": True, "elastic_keys": list(_ELASTIC_KEYS)})
+        return
+
+    import json as _json
+    import subprocess
+
+    # ---- (a) socket churn A/B: sync vs async close rule --------------
+    code = r"""
+import os, re, json
+flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+               os.environ.get("XLA_FLAGS", "")).strip()
+os.environ["XLA_FLAGS"] = flags
+import jax
+jax.config.update("jax_platforms", "cpu")
+import sys; sys.path.insert(0, %r)
+import bench
+from p2pfl_tpu.config.schema import (ScenarioConfig, TrainingConfig,
+    ProtocolConfig, DataConfig, ElasticConfig)
+from p2pfl_tpu.p2p.launch import run_simulation
+
+def cfg(async_mode):
+    return ScenarioConfig(
+        name="elastic24", n_nodes=24, topology="fully",
+        data=DataConfig(dataset="mnist", samples_per_node=60),
+        # rounds=4 leaves the scripted re-join (fires at 2*rounds//3)
+        # two full rounds of slack: async rounds close so fast that a
+        # later join would land after the cohort finished and the
+        # joiner would never see a STATE_SYNC
+        training=TrainingConfig(rounds=4, epochs_per_round=1,
+                                learning_rate=0.05),
+        # tighter timeouts than the socket24 continuity scenario: the
+        # sync arm's cost IS the timeout wait, and 60 s of it per
+        # crashed contributor would blow the phase budget while only
+        # scaling the same signal
+        protocol=ProtocolConfig(heartbeat_period_s=0.5,
+                                aggregation_timeout_s=12.0,
+                                vote_timeout_s=5.0, node_timeout_s=3.0,
+                                train_set_size=24, gossip_fanout=12),
+        elastic=ElasticConfig(async_aggregation=async_mode,
+                              min_received=0.5, staleness_beta=0.5,
+                              heartbeat_backoff_base_s=0.25,
+                              straggler_fraction=0.25,
+                              straggler_factor=4.0,
+                              churn_fraction=0.2),
+    )
+
+def arm(async_mode):
+    return lambda: run_simulation(cfg(async_mode), timeout=300)
+
+sync, asy = bench._ab_interleaved(arm(False), arm(True), pairs=1,
+                                  key="wall_s")
+print("BENCH_ELASTIC " + json.dumps({"sync": sync, "async": asy}),
+      flush=True)
+""" % (_REPO,)
+    try:
+        res = subprocess.run([sys.executable, "-c", code],
+                             capture_output=True, text=True, timeout=700)
+        got = None
+        for line in res.stdout.splitlines():
+            if line.startswith("BENCH_ELASTIC "):
+                got = _json.loads(line[len("BENCH_ELASTIC "):])
+        if not got:
+            print(f"elastic socket child rc={res.returncode}: "
+                  f"{res.stderr[-400:]}", file=sys.stderr, flush=True)
+        else:
+            sync, asy = got.get("sync") or {}, got.get("async") or {}
+            part = {
+                "elastic_sync_round_s": sync.get("round_s"),
+                "elastic_async_round_s": asy.get("round_s"),
+                "elastic_sync_wall_s": sync.get("wall_s"),
+                "elastic_async_wall_s": asy.get("wall_s"),
+                "elastic_sync_accuracy": sync.get("mean_accuracy"),
+                "elastic_async_accuracy": asy.get("mean_accuracy"),
+                "elastic_churn": asy.get("churn"),
+            }
+            if sync.get("wall_s") and asy.get("wall_s"):
+                part["elastic_async_speedup"] = round(
+                    sync["wall_s"] / asy["wall_s"], 2)
+            _part(part)
+    except Exception as e:
+        print(f"elastic socket A/B failed: {e!r}"[:300], file=sys.stderr,
+              flush=True)
+
+    # ---- (b) SPMD twin: staleness column off vs on under churn -------
+    try:
+        from p2pfl_tpu.config.schema import (
+            DataConfig,
+            ElasticConfig,
+            ScenarioConfig,
+            TrainingConfig,
+        )
+        from p2pfl_tpu.federation.scenario import Scenario
+
+        target = 0.85
+
+        def spmd_cfg(weighted: bool) -> ScenarioConfig:
+            return ScenarioConfig(
+                name="elastic-spmd", n_nodes=24, topology="ring",
+                data=DataConfig(dataset="mnist", samples_per_node=128),
+                training=TrainingConfig(rounds=12, epochs_per_round=1,
+                                        learning_rate=0.1, eval_every=1),
+                # same elastic seed on both arms -> identical straggler
+                # and churn cohorts; only the mix weighting differs
+                elastic=ElasticConfig(async_aggregation=weighted,
+                                      staleness_beta=0.5,
+                                      straggler_fraction=0.25,
+                                      straggler_factor=4.0,
+                                      churn_fraction=0.2),
+                seed=7,
+            )
+
+        res_off = Scenario(spmd_cfg(False)).run(target_accuracy=target)
+        _part({"elastic_spmd_target_accuracy": target,
+               "elastic_spmd_rounds_to_target": res_off.rounds_to_target,
+               "elastic_spmd_final_acc":
+                   round(res_off.final_accuracy, 4)})
+        res_on = Scenario(spmd_cfg(True)).run(target_accuracy=target)
+        _part({"elastic_spmd_rounds_to_target_weighted":
+                   res_on.rounds_to_target,
+               "elastic_spmd_final_acc_weighted":
+                   round(res_on.final_accuracy, 4)})
+    except Exception as e:
+        print(f"elastic SPMD arm failed: {e!r}"[:300], file=sys.stderr,
+              flush=True)
+
+
 def _phase_selftest() -> None:
     """Test hook (tests/test_bench_orchestration.py): emit one part,
     then crash — exercises the parent's guarantee that parts from a
@@ -1660,6 +1828,7 @@ def main() -> None:
         ("socket_mp", "_phase_socket_mp", 150),
         ("obs", "_phase_obs", 90),
         ("robust", "_phase_robust", 150),
+        ("elastic", "_phase_elastic", 150),
         ("vit32", "_phase_vit32", 120),
     ]
     for name, fn, min_s in phases:

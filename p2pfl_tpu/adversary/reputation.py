@@ -137,6 +137,14 @@ class ReputationMonitor:
         # sane cutoff, so two poisoned aggregates land before
         # exclusion, which at sign-flip scale 10 is fatal
         self._seen = np.zeros(n_nodes, bool)
+        # nodes caught red-handed by DIRECT evidence: a singleton
+        # entry (one contributor, nothing to hide behind) scoring
+        # below the cutoff. Only these anchor the explaining-away in
+        # observe_entries — keying it on low *trust* latched onto
+        # false positives (an honest node whose round-0 appearance was
+        # merged with the attacker), permanently shielding the real
+        # attacker behind the mislabeled node.
+        self._confirmed_bad = np.zeros(n_nodes, bool)
         #: per-round trust snapshots (monitor/webapp export)
         self.history: list[list[float]] = []
 
@@ -163,10 +171,16 @@ class ReputationMonitor:
         ``[(contributor_frozenset, params_tree), ...]`` from one
         session; ``reference`` is the round-start params the session's
         owner trained from. Each entry's delta is scored; an entry's
-        score becomes the observation of EVERY contributor (a partial
-        aggregate containing an attacker is itself anomalous — its
-        honest co-contributors take a transient hit and recover via
-        the EWMA, while the attacker is hit every round)."""
+        score becomes an observation of every ATTRIBUTED contributor
+        (a partial aggregate containing an attacker is itself
+        anomalous — its honest co-contributors take a transient hit
+        and recover via the EWMA, while the attacker is hit every
+        round), with evidence weight ``1/|attributed|``: a singleton
+        entry is direct evidence about one node, a k-way merged
+        partial only says *someone* in it misbehaved. Attribution is
+        sharpened by explaining-away anchored on DIRECT evidence —
+        see the loop comments for why both halves (the singleton
+        anchor, the redirect) are load-bearing."""
         import jax
 
         ref_flat = np.concatenate(
@@ -184,15 +198,38 @@ class ReputationMonitor:
             ]
         )
         scores = cohort_scores(deltas, xp=np)
-        obs_sum = np.zeros(self.n_nodes, np.float64)
-        obs_cnt = np.zeros(self.n_nodes, np.int64)
+        # pass 1: singleton entries are DIRECT evidence — one scoring
+        # below the cutoff confirms its contributor as bad (sticky:
+        # an honest node's own update essentially never scores that
+        # low, and an attacker alternating good rounds should not be
+        # able to launder its merged partials)
         for key, s in zip(keys, scores):
-            for c in key:
-                if 0 <= c < self.n_nodes:
-                    obs_sum[c] += float(s)
-                    obs_cnt[c] += 1
+            ids = [c for c in key if 0 <= c < self.n_nodes]
+            if len(ids) == 1 and float(s) < self.cutoff:
+                self._confirmed_bad[ids[0]] = True
+        obs_sum = np.zeros(self.n_nodes, np.float64)
+        obs_cnt = np.zeros(self.n_nodes, np.float64)
+        for key, s in zip(keys, scores):
+            ids = [c for c in key if 0 <= c < self.n_nodes]
+            if not ids:
+                continue
+            # explaining-away, anchored on CONFIRMED culprits only: an
+            # entry containing a caught-red-handed node says nothing
+            # new about its other contributors — the low score is
+            # fully explained by the known-bad model merged in.
+            # Attributing such entries to everyone let the attacker's
+            # partials keep dragging honest co-contributors down every
+            # round — gossip timing could leave an honest node ranked
+            # BELOW the attacker at the end (the measured ~1/3 flake
+            # of the 4-node socket recovery test).
+            bad = [c for c in ids if self._confirmed_bad[c]]
+            targets = bad or ids
+            ev = 1.0 / max(len(targets), 1)
+            for c in targets:
+                obs_sum[c] += float(s) * ev
+                obs_cnt[c] += ev
         mask = obs_cnt > 0
-        per_node = np.where(mask, obs_sum / np.maximum(obs_cnt, 1), 0.0)
+        per_node = np.where(mask, obs_sum / np.maximum(obs_cnt, 1e-9), 0.0)
         self.observe(per_node.astype(np.float32), mask)
 
     # -- weight shaping --------------------------------------------------
@@ -205,14 +242,18 @@ class ReputationMonitor:
 
     def entry_scales(self, keys) -> np.ndarray:
         """Per-entry weight multipliers for a session's stored models:
-        the mean trust multiplier of each entry's contributors (an
+        the MIN trust multiplier over each entry's contributors (an
         unknown/empty contributor set is left at 1.0 — no evidence,
-        no penalty)."""
+        no penalty). Min, not mean: contamination is not additive — a
+        partial merged with a zero-trust sign-flipper is poisoned
+        through and through, and averaging it in at half weight still
+        wrecks the aggregate at attack scale 10. Better to drop the
+        honest contributions trapped in it than to admit the poison."""
         wv = self.weights_vector()
         out = []
         for key in keys:
             ids = [c for c in key if 0 <= c < self.n_nodes]
-            out.append(float(np.mean(wv[ids])) if ids else 1.0)
+            out.append(float(np.min(wv[ids])) if ids else 1.0)
         return np.asarray(out, np.float32)
 
     def suspects(self) -> list[int]:

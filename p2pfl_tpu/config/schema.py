@@ -182,24 +182,114 @@ class FaultEvent:
     ``round`` (and optionally recovers). The reference can only inject
     network degradation via tcset (base_node.py:82-85); crash-testing
     there means killing processes by hand. Here it is scenario state.
+
+    ``join`` is ``recover`` plus state transfer: the node re-enters
+    through the live join handshake (CONNECT hello + checkpoint-format
+    model fetch) instead of resuming with whatever params it died with.
     """
 
     node: int = 0
     round: int = 0
-    kind: str = "crash"  # crash | recover
+    kind: str = "crash"  # crash | recover | join
+
+    def __post_init__(self):
+        known = ("crash", "recover", "join")
+        if self.kind not in known:
+            raise ValueError(
+                f"unknown fault kind {self.kind!r}; have {known}"
+            )
+
+
+@dataclasses.dataclass
+class ElasticConfig:
+    """Elasticity knobs (round 11): buffered async aggregation,
+    heartbeat-based peer-death detection, and declarative
+    churn/straggler scripting.
+
+    The reference has none of this surface: a round is a synchronous
+    barrier over a fixed roster, so one dead or slow node stalls the
+    federation until ``aggregation_timeout_s``. Flower/FLARE ground the
+    client-lifecycle API this mirrors; Totoro+ grounds adaptive
+    participation under heterogeneous capacity (PAPERS.md).
+    """
+
+    # ---- buffered async aggregation ------------------------------------
+    # close the round when min_received (fraction of the expected train
+    # set) have arrived OR the deadline fires; late updates are folded
+    # in staleness-discounted instead of dropped
+    async_aggregation: bool = False
+    min_received: float = 0.5
+    # staleness discount exponent: w -> w / (1 + staleness)^beta.
+    # beta=0 disables the discount (stale == fresh); the formula is
+    # shared verbatim by both planes (parallel.federated.staleness_scale)
+    staleness_beta: float = 0.5
+    # ---- heartbeat death detection (socket plane) ----------------------
+    # a peer silent for node_timeout_s becomes SUSPECT; reconnect probes
+    # back off exponentially (base * 2^k, capped at max) and after
+    # retry_limit failed probes the peer is evicted from membership
+    # (sticky until it re-enters through the join handshake)
+    heartbeat_retry_limit: int = 3
+    heartbeat_backoff_base_s: float = 0.5
+    heartbeat_backoff_max_s: float = 8.0
+    # ---- declarative churn + straggler scripting -----------------------
+    # materialize_elastic() turns these into per-node profiles and
+    # FaultEvents so "20% churn + 4x straggler skew" is one config line
+    straggler_fraction: float = 0.0
+    # compute-class skew: a straggler's fit takes factor x as long
+    # (injected delay proportional to its measured fit time) and its
+    # update lands with proportional staleness on the SPMD plane
+    straggler_factor: float = 1.0
+    churn_fraction: float = 0.0
+    seed: int = 0
+
+    def __post_init__(self):
+        if not 0.0 < self.min_received <= 1.0:
+            raise ValueError(
+                f"min_received must be in (0, 1], got {self.min_received}"
+            )
+        if self.staleness_beta < 0.0:
+            raise ValueError(
+                f"staleness_beta must be >= 0, got {self.staleness_beta}"
+            )
+        if self.straggler_factor < 1.0:
+            raise ValueError(
+                f"straggler_factor must be >= 1, got {self.straggler_factor}"
+            )
+        for name in ("straggler_fraction", "churn_fraction"):
+            v = getattr(self, name)
+            if not 0.0 <= v <= 1.0:
+                raise ValueError(f"{name} must be in [0, 1], got {v}")
+        if self.heartbeat_retry_limit < 1:
+            raise ValueError("heartbeat_retry_limit must be >= 1")
+
+    @property
+    def active(self) -> bool:
+        return (self.async_aggregation or self.straggler_fraction > 0.0
+                or self.churn_fraction > 0.0)
 
 
 @dataclasses.dataclass
 class NodeConfig:
-    """Per-node overrides (device_args in the reference)."""
+    """Per-node overrides (device_args in the reference), including the
+    round-11 compute class: ``epochs`` overrides the federation-wide
+    local epoch count and ``fit_slowdown`` >= 1 injects a fit delay
+    proportional to the node's measured fit time (a 4x straggler is
+    ``fit_slowdown=4.0`` — true relative skew without guessing absolute
+    times)."""
 
     idx: int = 0
     role: str = "trainer"
     start: bool = False  # which node initiates learning (device_args.start)
+    epochs: int | None = None  # None = training.epochs_per_round
+    fit_slowdown: float = 1.0
 
     def __post_init__(self):
         if self.role not in ROLES:
             raise ValueError(f"unknown role {self.role!r}; have {ROLES}")
+        if self.fit_slowdown < 1.0:
+            raise ValueError(
+                f"fit_slowdown must be >= 1, got {self.fit_slowdown}"
+            )
 
 
 @dataclasses.dataclass
@@ -221,6 +311,7 @@ class ScenarioConfig:
     adversary: AdversaryConfig = dataclasses.field(
         default_factory=AdversaryConfig
     )
+    elastic: ElasticConfig = dataclasses.field(default_factory=ElasticConfig)
     # weight-exchange collective schedule: "dense" = all-gather einsum;
     # "sparse" = per-edge-offset ppermute (O(degree) ICI traffic, DFL +
     # one node per device only); "auto" picks sparse when it is legal
@@ -287,6 +378,7 @@ class ScenarioConfig:
             raise ValueError(
                 f"{len(self.nodes)} node configs for n_nodes={self.n_nodes}"
             )
+        self.materialize_elastic()
 
     def _default_nodes(self) -> list[NodeConfig]:
         """Role assignment by federation scheme (controller.py:247-298 +
@@ -303,6 +395,50 @@ class ScenarioConfig:
                 role = "aggregator"  # DFL: trainer+aggregator combined
             nodes.append(NodeConfig(idx=i, role=role, start=(i == 0)))
         return nodes
+
+    def materialize_elastic(self) -> None:
+        """Expand the declarative churn/straggler knobs into concrete
+        per-node profiles and FaultEvents (idempotent — the derivation
+        is deterministic in ``elastic.seed`` and assigns absolute
+        values, so a JSON round-trip re-derives the same state).
+
+        Stragglers: the last ``ceil(straggler_fraction * n)`` nodes of a
+        seeded shuffle get ``fit_slowdown = straggler_factor``. Churn:
+        a disjoint ``ceil(churn_fraction * n)`` cohort (never the
+        starter) crashes at ~1/3 of the rounds and re-enters via the
+        join handshake at ~2/3."""
+        import math
+        import random
+
+        el = self.elastic
+        if el.straggler_fraction <= 0.0 and el.churn_fraction <= 0.0:
+            return
+        rng = random.Random((el.seed, "elastic", self.n_nodes).__repr__())
+        order = list(range(self.n_nodes))
+        rng.shuffle(order)
+        # the starter must neither churn nor straggle: it owns model
+        # init and the first START_LEARNING broadcast
+        starters = {nc.idx for nc in self.nodes if nc.start} or {0}
+        order = [i for i in order if i not in starters]
+        n_strag = math.ceil(el.straggler_fraction * self.n_nodes)
+        n_churn = math.ceil(el.churn_fraction * self.n_nodes)
+        stragglers = set(order[:n_strag])
+        churners = set(order[n_strag:n_strag + n_churn])
+        by_idx = {nc.idx: nc for nc in self.nodes}
+        for i in stragglers:
+            by_idx[i].fit_slowdown = el.straggler_factor
+        rounds = self.training.rounds
+        crash_r = max(rounds // 3, 1)
+        join_r = max((2 * rounds) // 3, crash_r + 1)
+        planned = [
+            FaultEvent(node=i, round=r, kind=k)
+            for i in sorted(churners)
+            for r, k in ((crash_r, "crash"), (join_r, "join"))
+        ]
+        have = {(f.node, f.round, f.kind) for f in self.faults}
+        self.faults.extend(
+            f for f in planned if (f.node, f.round, f.kind) not in have
+        )
 
     # ---- JSON round-trip -------------------------------------------------
 
@@ -322,6 +458,7 @@ class ScenarioConfig:
             ("protocol", ProtocolConfig),
             ("network", NetworkConfig),
             ("adversary", AdversaryConfig),
+            ("elastic", ElasticConfig),
         ]:
             if field in d and isinstance(d[field], dict):
                 d[field] = cls(**d[field])

@@ -27,6 +27,43 @@ def checkpoint_path(directory: str | pathlib.Path, round_num: int) -> pathlib.Pa
     return pathlib.Path(directory) / f"round_{round_num:05d}{_SUFFIX}"
 
 
+# ---- wire transfer (round 11: live join handshake) ---------------------
+
+def pack_model(params: Any, round_num: int) -> bytes:
+    """One params tree + its round as a checkpoint-format msgpack blob
+    — the payload an established node ships to a live joiner (p2p
+    STATE_SYNC). Same serialization as the on-disk checkpoint
+    (``to_state_dict`` -> ``msgpack_serialize``), so the join path and
+    the restart-from-disk path cannot drift."""
+    host = jax.tree.map(np.asarray, params)
+    return flax_ser.msgpack_serialize(
+        {"round": int(round_num), "params": flax_ser.to_state_dict(host)}
+    )
+
+
+def unpack_model(blob: bytes, template: Any) -> tuple[Any, int]:
+    """Restore a ``pack_model`` blob into the structure of
+    ``template``; returns ``(params, round)``. Leaves are copied
+    (non-owning msgpack views must never back donated buffers — see
+    ``load_checkpoint``) and dtype-conformed to the template."""
+    obj = flax_ser.msgpack_restore(blob)
+    try:
+        restored = flax_ser.from_state_dict(template, obj["params"])
+    except Exception as e:
+        raise ValueError(f"state blob does not match model: {e}") from e
+    flat_t, treedef = jax.tree.flatten(template)
+    flat_r = jax.tree.leaves(restored)
+    conformed = []
+    for t, r in zip(flat_t, flat_r):
+        r = np.array(r, copy=True)
+        if r.shape != np.shape(t):
+            raise ValueError(
+                f"state blob leaf shape {r.shape} != expected {np.shape(t)}"
+            )
+        conformed.append(r.astype(np.asarray(t).dtype))
+    return jax.tree.unflatten(treedef, conformed), int(obj.get("round", 0))
+
+
 def save_checkpoint(directory: str | pathlib.Path, fed: FederatedState) -> pathlib.Path:
     """Write the federation state; returns the file path.
 

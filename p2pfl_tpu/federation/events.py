@@ -21,6 +21,9 @@ class Events(enum.Enum):
     ROUND_FINISHED = "round_finished"
     NODE_DIED = "node_died"  # heartbeat eviction (heartbeater.py:88-101)
     NODE_RECOVERED = "node_recovered"
+    # round 11 elasticity: a node entered through the live join
+    # handshake (CONNECT hello + checkpoint-format model fetch)
+    NODE_JOINED = "node_joined"
     LEADERSHIP_TRANSFERRED = "leadership_transferred"  # node.py:676-686
     LEARNING_FINISHED = "learning_finished"
     METRICS_REPORTED = "metrics_reported"  # REPORT_STATUS analog

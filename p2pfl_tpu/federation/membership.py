@@ -21,12 +21,22 @@ class Membership(Observable):
     ``beat(i, t)`` = a heartbeat from node i at time t (heartbeater
     add_node analog). ``advance_to(t)`` evicts nodes silent for longer
     than ``node_timeout_s`` and fires NODE_DIED (clear_nodes analog).
-    Fault injection (FaultEvent crash/recover) simply stops/resumes a
-    node's heartbeats.
+    Fault injection (FaultEvent crash/recover/join) simply stops/
+    resumes a node's heartbeats.
+
+    Round 11 adds the suspect/probe state machine the socket plane
+    wires to ACTUAL peer-death detection: a node whose heartbeats time
+    out becomes SUSPECT (``NODE_DIED`` fires — the existing timeout
+    semantics are unchanged); the owner then probes a reconnect under
+    exponential backoff (``backoff_base_s * 2^k``, capped), and after
+    ``retry_limit`` failed probes ``evict()`` makes the departure
+    sticky. A heartbeat at any point before final eviction clears the
+    suspicion (``NODE_RECOVERED``).
     """
 
     def __init__(self, n_nodes: int, protocol: ProtocolConfig | None = None,
-                 virtual: bool = True):
+                 virtual: bool = True, retry_limit: int = 3,
+                 backoff_base_s: float = 0.5, backoff_max_s: float = 8.0):
         """``virtual=True`` (simulation): the clock synthesizes beats
         for nodes whose ``beating`` flag is set, so liveness is fully
         scripted by FaultEvents. ``virtual=False`` (DCN/real mode):
@@ -41,6 +51,12 @@ class Membership(Observable):
         self.alive = np.ones(n_nodes, bool)  # membership view
         self.departed = np.zeros(n_nodes, bool)  # explicit STOP leavers
         self.clock = 0.0
+        # suspect/probe bookkeeping (socket plane death detection)
+        self.retry_limit = int(retry_limit)
+        self.backoff_base_s = float(backoff_base_s)
+        self.backoff_max_s = float(backoff_max_s)
+        self.probe_failures = np.zeros(n_nodes, np.int64)
+        self.next_probe = np.full(n_nodes, np.inf, np.float64)
 
     def beat(self, node: int, t: float | None = None) -> None:
         if self.departed[node]:
@@ -50,6 +66,8 @@ class Membership(Observable):
             return
         t = self.clock if t is None else t
         self.last_seen[node] = t
+        self.probe_failures[node] = 0
+        self.next_probe[node] = np.inf
         if not self.alive[node]:
             self.alive[node] = True
             self.notify(Events.NODE_RECOVERED, {"node": node, "t": t})
@@ -57,12 +75,44 @@ class Membership(Observable):
     def apply_fault(self, fault: FaultEvent) -> None:
         if fault.kind == "crash":
             self.beating[fault.node] = False
-        elif fault.kind == "recover":
+        elif fault.kind in ("recover", "join"):
+            # "join" is recover at this layer; the state transfer
+            # (checkpoint-format model fetch) is the caller's job
             self.departed[fault.node] = False
             self.beating[fault.node] = True
             self.beat(fault.node)
+            if fault.kind == "join":
+                self.notify(Events.NODE_JOINED,
+                            {"node": fault.node, "t": self.clock})
         else:
             raise ValueError(f"unknown fault kind {fault.kind!r}")
+
+    # -- suspect/probe state machine (socket plane) ----------------------
+    def probes_due(self, t: float | None = None) -> list[int]:
+        """Suspect nodes whose next reconnect probe is due at ``t``:
+        dead (heartbeat timeout) but not yet finally evicted, with
+        retry budget remaining."""
+        t = self.clock if t is None else t
+        return [
+            int(i) for i in range(self.n)
+            if (not self.alive[i] and not self.departed[i]
+                and self.probe_failures[i] < self.retry_limit
+                and t >= self.next_probe[i])
+        ]
+
+    def probe_failed(self, node: int, t: float | None = None) -> bool:
+        """Record one failed reconnect probe; schedule the next under
+        exponential backoff. Returns True when the retry budget is
+        exhausted — the caller should ``evict`` (and tear down lanes).
+        """
+        t = self.clock if t is None else t
+        self.probe_failures[node] += 1
+        k = int(self.probe_failures[node])
+        if k >= self.retry_limit:
+            return True
+        delay = min(self.backoff_base_s * (2.0 ** k), self.backoff_max_s)
+        self.next_probe[node] = t + delay
+        return False
 
     def advance_to(self, t: float) -> np.ndarray:
         """Advance the virtual clock: beating nodes emit heartbeats at
@@ -82,6 +132,10 @@ class Membership(Observable):
         for node in range(self.n):
             if self.alive[node] and t - self.last_seen[node] > timeout:
                 self.alive[node] = False
+                # open the suspect window: first reconnect probe due
+                # one backoff base from the detected timeout
+                self.probe_failures[node] = 0
+                self.next_probe[node] = t + self.backoff_base_s
                 self.notify(Events.NODE_DIED, {"node": node, "t": t})
         return self.alive.copy()
 
@@ -91,6 +145,7 @@ class Membership(Observable):
         straggler beats."""
         self.departed[node] = True
         self.beating[node] = False
+        self.next_probe[node] = np.inf  # no further reconnect probes
         if self.alive[node]:
             self.alive[node] = False
             self.notify(Events.NODE_DIED, {"node": node, "t": self.clock})

@@ -53,6 +53,7 @@ from p2pfl_tpu.parallel.federated import (
     build_round_fn_sparse,
     init_federation,
     make_round_plan,
+    staleness_scale,
     with_staged_buffer,
 )
 from p2pfl_tpu.obs import trace as obs_trace
@@ -165,6 +166,26 @@ class Scenario(Observable):
                               cutoff=adv.reputation_cutoff)
             if adv.reputation else None
         )
+
+        # ---- elasticity wiring (round 11): in async mode a straggler
+        # of compute class k delivers updates ~k-1 rounds stale, and
+        # the SPMD twin of the socket session's entry-weight discount
+        # is the SAME host-side f32 formula applied as a COLUMN scale
+        # on the mixing matrix (the reputation pattern: w = mix *
+        # n_samples, so scaling column j reweights node j's
+        # contribution in every aggregate — no round-fn change, no
+        # recompile). Static across rounds, so it composes with the
+        # plan cache.
+        el = config.elastic
+        self._stale_scale: np.ndarray | None = None
+        if el.async_aggregation and el.staleness_beta > 0.0:
+            stale_rounds = np.asarray(
+                [nc.fit_slowdown - 1.0 for nc in config.nodes], np.float32
+            )
+            if np.any(stale_rounds > 0.0):
+                self._stale_scale = staleness_scale(
+                    stale_rounds, el.staleness_beta
+                )
 
         # ---- device-side setup
         x, y, smask, nsamp = self.dataset.stacked()
@@ -311,12 +332,41 @@ class Scenario(Observable):
         # every subsequent mix weight match exactly
         start_round = int(self._node_host(self.fed.round))
         for r in range(start_round):
-            alive = self._advance_membership(r)
+            alive = self._advance_membership(r, replay=True)
             self._rotate_leader(alive, replay=True)
 
-    def _advance_membership(self, round_num: int) -> np.ndarray:
+    def _sync_join_row(self, node: int, round_num: int) -> None:
+        """SPMD twin of the socket STATE_SYNC half of a live join: the
+        joining row adopts the current leader row's params (the
+        federation's "current global model"), so a mid-run joiner
+        re-enters from the cohort's state instead of whatever its row
+        drifted to while dead. Joins are rare, so the eager row copy
+        (one gather+scatter across the stacked params) is fine."""
+        src = self.leader
+        if src == node:
+            src = next(
+                (i for i in self.membership.get_nodes() if i != node), None
+            )
+            if src is None:
+                return
+        params = jax.tree.map(
+            lambda x: x.at[node].set(x[src]), self.fed.states.params
+        )
+        self.fed = self.fed.replace(
+            states=self.fed.states.replace(params=params)
+        )
+        self.notify(Events.NODE_JOINED, {"node": node, "round": round_num})
+
+    def _advance_membership(self, round_num: int,
+                            replay: bool = False) -> np.ndarray:
         for fault in self._faults_by_round.get(round_num, []):
             self.membership.apply_fault(fault)
+            # replayed rounds (checkpoint resume) skip the row copy:
+            # the restored state already CONTAINS the post-join params,
+            # and re-copying today's leader row would diverge from the
+            # uninterrupted trajectory
+            if fault.kind == "join" and not replay:
+                self._sync_join_row(fault.node, round_num)
         # one round advances the virtual clock by one heartbeat period —
         # eviction after node_timeout_s therefore takes
         # ceil(timeout/period) rounds of silence, like the reference's
@@ -403,6 +453,8 @@ class Scenario(Observable):
                 plan.mix.astype(np.float32)
                 * self.reputation.weights_vector()[None, :]
             )
+            if self._stale_scale is not None:
+                mix = mix * self._stale_scale[None, :]
             tr = self.transport
             return (
                 tr.put_stacked(jnp.asarray(mix)),
@@ -423,9 +475,12 @@ class Scenario(Observable):
                 self.topology, self.roles, self.config.federation, self.leader
             )
             trains = plan.trains if trains_override is None else trains_override
+            mix = plan.mix
+            if self._stale_scale is not None:
+                mix = mix.astype(np.float32) * self._stale_scale[None, :]
             tr = self.transport
             self._plan_cache[key] = (
-                tr.put_stacked(jnp.asarray(plan.mix)),
+                tr.put_stacked(jnp.asarray(mix)),
                 tr.put_stacked(jnp.asarray(plan.adopt)),
                 tr.put_stacked(jnp.asarray(trains)),
             )

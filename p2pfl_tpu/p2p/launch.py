@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import argparse
 import asyncio
+import contextlib
 import dataclasses
 import json
 import pathlib
@@ -175,6 +176,9 @@ async def _run_node(cfg: ScenarioConfig, idx: int, ports: list[int],
         netem=cfg.network,
         full_mesh=_declares_full_mesh(cfg),
         wire_dtype=cfg.wire_dtype,
+        elastic=cfg.elastic,
+        fit_slowdown=cfg.nodes[idx].fit_slowdown,
+        local_epochs=cfg.nodes[idx].epochs,
         **adv_kwargs,
     )
     await node.start()
@@ -337,6 +341,9 @@ async def _simulate(cfg: ScenarioConfig, timeout: float = 600) -> dict:
             netem=cfg.network,
             full_mesh=_declares_full_mesh(cfg),
             wire_dtype=cfg.wire_dtype,
+            elastic=cfg.elastic,
+            fit_slowdown=cfg.nodes[i].fit_slowdown,
+            local_epochs=cfg.nodes[i].epochs,
             **adv_kwargs[i],
         )
         for i in range(n)
@@ -362,17 +369,85 @@ async def _simulate(cfg: ScenarioConfig, timeout: float = 600) -> dict:
     # are expected; anything counted past this point is a mid-round
     # recompile (the round-7 storm this counter exists to surface)
     obs_trace.reset_xla_counters()
+
+    # ---- scripted churn (round 11): on the socket plane FaultEvents
+    # drive ACTUAL node death and live re-join — a "crash" is an
+    # abrupt teardown peers must detect via heartbeat silence and the
+    # probe machine, a "join"/"recover" builds a FRESH P2PNode that
+    # re-enters through the live-join handshake ("jr" hello →
+    # STATE_SYNC model fetch) instead of a scripted beating flag.
+    el = cfg.elastic
+    joined: list[int] = []
+
+    async def _rejoin_node(i: int) -> None:
+        ln = JaxLearner(model=None, data=data.nodes[i],
+                        batch_size=cfg.data.batch_size, seed=cfg.seed,
+                        trainer=shared)
+        nd = P2PNode(
+            i, ln, role=cfg.nodes[i].role, n_nodes=n,
+            aggregator=get_aggregator(cfg.aggregator,
+                                      **cfg.aggregator_kwargs),
+            protocol=cfg.protocol, federation=cfg.federation,
+            seed=cfg.seed, netem=cfg.network,
+            full_mesh=_declares_full_mesh(cfg),
+            wire_dtype=cfg.wire_dtype, elastic=el,
+            fit_slowdown=cfg.nodes[i].fit_slowdown,
+            local_epochs=cfg.nodes[i].epochs,
+            joiner=True,
+            **adv_kwargs[i],
+        )
+        nodes[i] = nd
+        await nd.start()
+        ln.warm_up()  # shared trainer is already compiled — cheap
+        for j in topo.neighbors(i):
+            other = nodes[j]
+            if other is nd or other.finished.is_set():
+                continue
+            try:
+                await nd.connect_to(other.host, other.port)
+            except OSError:
+                continue
+        joined.append(i)
+
+    fault_task = None
+    if cfg.faults:
+        events = sorted(cfg.faults, key=lambda f: (f.round, f.node))
+
+        async def _fault_driver() -> None:
+            for f in events:
+                while True:
+                    fronts = [nd.round for nd in nodes
+                              if not nd.finished.is_set()]
+                    if not fronts:
+                        return  # federation over; remaining faults moot
+                    if max(fronts) >= f.round:
+                        break
+                    await asyncio.sleep(0.05)
+                if f.kind == "crash":
+                    await nodes[f.node].crash()
+                else:  # recover / join: live re-entry via the handshake
+                    await _rejoin_node(f.node)
+
+        fault_task = asyncio.create_task(_fault_driver())
+
+    async def _all_finished() -> None:
+        # replacement-aware: a join swaps nodes[i] for a fresh object,
+        # so a plain gather over the initial events would miss it
+        while not all(nd.finished.is_set() for nd in nodes):
+            await asyncio.sleep(0.1)
+
     t0 = time.monotonic()
     nodes[starter].set_start_learning(
         cfg.training.rounds, cfg.training.epochs_per_round
     )
     try:
-        await asyncio.wait_for(
-            asyncio.gather(*(nd.finished.wait() for nd in nodes)),
-            timeout=timeout,
-        )
+        await asyncio.wait_for(_all_finished(), timeout=timeout)
     finally:
         wall = time.monotonic() - t0
+        if fault_task is not None:
+            fault_task.cancel()
+            with contextlib.suppress(asyncio.CancelledError):
+                await fault_task
         for node in nodes:
             await node.stop()
     accs = [
@@ -397,6 +472,18 @@ async def _simulate(cfg: ScenarioConfig, timeout: float = 600) -> dict:
         # numerator, isolated from control-plane traffic
         "params_bytes_out": sum(nd.params_bytes_out for nd in nodes),
     }
+    if cfg.faults or el.active:
+        # elasticity accounting: who crashed/re-joined, which nodes ran
+        # slow, and whether the async close rule was on — the churn
+        # bench and the elasticity tests read these
+        out["churn"] = {
+            "async": el.async_aggregation,
+            "crashes": sorted(f.node for f in cfg.faults
+                              if f.kind == "crash"),
+            "joined": sorted(joined),
+            "stragglers": [i for i in range(n)
+                           if cfg.nodes[i].fit_slowdown > 1.0],
+        }
     if tracer.enabled:
         out["obs"] = tracer.summarize()
         tracer.export(process_name=f"sim[{cfg.name}]")

@@ -29,6 +29,7 @@ import asyncio
 import contextlib
 import dataclasses
 import logging
+import math
 import random
 import secrets
 import time
@@ -37,7 +38,7 @@ from typing import Any
 import jax
 import numpy as np
 
-from p2pfl_tpu.config.schema import ProtocolConfig
+from p2pfl_tpu.config.schema import ElasticConfig, FaultEvent, ProtocolConfig
 from p2pfl_tpu.core.aggregators import Aggregator
 from p2pfl_tpu.core.serialize import (
     WIRE_DTYPES,
@@ -127,6 +128,10 @@ class P2PNode:
         attack=None,
         reputation=None,
         wire_dtype: str = "f32",
+        elastic: ElasticConfig | None = None,
+        fit_slowdown: float = 1.0,
+        local_epochs: int | None = None,
+        joiner: bool = False,
     ):
         from p2pfl_tpu.p2p.session import AggregationSession
 
@@ -219,11 +224,36 @@ class P2PNode:
         # per-round wall clocks (appended by _learning_loop) — the p95
         # the status publisher reports comes from here
         self.round_wall_s: list[float] = []
+        # elasticity profile (round 11): async aggregation knobs feed
+        # the session, heartbeat probe/backoff knobs feed membership,
+        # and the per-node compute class (fit_slowdown / local_epochs)
+        # shapes _fit. ``joiner`` marks a node entering a RUNNING
+        # federation: its CONNECT hello declares the join ("jr") and
+        # the established side answers with STATE_SYNC.
+        el = elastic if elastic is not None else ElasticConfig()
+        self.elastic = el
+        self.fit_slowdown = float(fit_slowdown)
+        self.local_epochs = local_epochs
+        self.joiner = bool(joiner)
+        # dial-back addresses, learned from CONNECT hellos — reconnect
+        # probes redial these when a peer's heartbeats go silent
+        self._peer_addrs: dict[int, tuple[str, int]] = {}
+        # STATE_SYNC round target that arrived while a round body was
+        # active — applied at the next round boundary (jumping
+        # self.round mid-round would desync the live session)
+        self._join_round_target: int | None = None
         self.session = AggregationSession(
             aggregator, timeout_s=self.protocol.aggregation_timeout_s,
             reputation=reputation, lane=self._lane,
+            min_received=el.min_received if el.async_aggregation else 1.0,
+            staleness_beta=el.staleness_beta if el.async_aggregation else 0.0,
         )
-        self.membership = Membership(n_nodes, self.protocol, virtual=False)
+        self.membership = Membership(
+            n_nodes, self.protocol, virtual=False,
+            retry_limit=el.heartbeat_retry_limit,
+            backoff_base_s=el.heartbeat_backoff_base_s,
+            backoff_max_s=el.heartbeat_backoff_max_s,
+        )
         self.peers: dict[int, PeerState] = {}
         self.progress: dict[int, NodeProgress] = {}
         self.peer_roles: dict[int, str] = {}
@@ -266,6 +296,7 @@ class P2PNode:
         self._server: asyncio.Server | None = None
         self._tasks: list[asyncio.Task] = []
         self._learn_task: asyncio.Task | None = None
+        self._crashed = False
         self.finished = asyncio.Event()
 
     # ------------------------------------------------------------------
@@ -280,7 +311,40 @@ class P2PNode:
         self.membership.beat(self.idx, 0.0)
         self._tasks.append(asyncio.create_task(self._heartbeat_loop()))
 
+    async def crash(self) -> None:
+        """Failure injection (round 11 churn): abrupt teardown WITHOUT
+        the STOP announcement — peers must detect the death through
+        heartbeat silence and the reconnect-probe machine, exactly as
+        for a real process kill. stop() after a crash is a no-op."""
+        if self._crashed:
+            return
+        self._crashed = True
+        self.learning = False
+        for t in [self._learn_task, *self._tasks]:
+            if t is not None:
+                t.cancel()
+                with contextlib.suppress(asyncio.CancelledError):
+                    await t
+        if self.shaper is not None:
+            self.shaper.close()
+        for peer in list(self.peers.values()):
+            if peer.send_task:
+                peer.send_task.cancel()
+                with contextlib.suppress(asyncio.CancelledError):
+                    await peer.send_task
+            if peer.reader_task:
+                peer.reader_task.cancel()
+                with contextlib.suppress(asyncio.CancelledError):
+                    await peer.reader_task
+            peer.writer.close()
+        self.peers.clear()
+        if self._server:
+            self._server.close()
+        self.finished.set()
+
     async def stop(self) -> None:
+        if self._crashed:
+            return
         # announce departure so peers drop us immediately instead of
         # waiting out the heartbeat timeout (Stop_cmd semantics).
         # Per-peer time bound, sent concurrently: one peer with a full
@@ -354,6 +418,16 @@ class P2PNode:
         )
         return False
 
+    def _hello_body(self) -> dict:
+        """CONNECT hello body: dial-back port, supported wire dtypes,
+        and — when this node is entering a RUNNING federation — the
+        live-join declaration ``"jr"`` (the last round it knows). The
+        established side answers a ``"jr"`` hello with STATE_SYNC."""
+        body = {"port": self.port, "wd": list(WIRE_DTYPES)}
+        if self.joiner:
+            body["jr"] = self.round
+        return body
+
     async def connect_to(self, host: str, port: int) -> None:
         """Dial a neighbor (base_node.py connect_to)."""
         reader, writer = await asyncio.open_connection(
@@ -363,8 +437,7 @@ class P2PNode:
         await write_message(
             writer,
             self._sign(Message(MsgType.CONNECT, self.idx,
-                               {"port": self.port,
-                                "wd": list(WIRE_DTYPES)})),
+                               self._hello_body())),
         )
         hello = await read_message(reader)
         if not self._hello_ok(hello, writer):
@@ -372,6 +445,7 @@ class P2PNode:
             raise ConnectionError("peer hello does not match its certificate")
         self._record_peer_wire(hello)
         peer = self._register_peer(int(hello.sender), reader, writer)
+        self._on_hello_extras(peer, hello, host=host)
         log.debug("node %d connected to %d", self.idx, peer.idx)
 
     async def _on_connection(self, reader, writer) -> None:
@@ -388,11 +462,67 @@ class P2PNode:
         await write_message(
             writer,
             self._sign(Message(MsgType.CONNECT, self.idx,
-                               {"port": self.port,
-                                "wd": list(WIRE_DTYPES)})),
+                               self._hello_body())),
         )
         self._record_peer_wire(hello)
-        self._register_peer(int(hello.sender), reader, writer)
+        peer = self._register_peer(int(hello.sender), reader, writer)
+        self._on_hello_extras(peer, hello)
+
+    def _on_hello_extras(self, peer: PeerState, hello: Message,
+                         host: str | None = None) -> None:
+        """Round-11 CONNECT extensions, applied once the connection is
+        registered: remember the peer's dial-back address (reconnect
+        probes redial it on heartbeat silence), and honor a live-join
+        declaration ("jr") — clear any sticky departure so the joiner
+        re-enters membership, and answer with the current model."""
+        port = hello.body.get("port")
+        if host is None:
+            peername = peer.writer.get_extra_info("peername")
+            host = peername[0] if peername else None
+        if host is not None and port is not None:
+            self._peer_addrs[peer.idx] = (host, int(port))
+        if hello.body.get("jr") is None:
+            return
+        self.membership.apply_fault(
+            FaultEvent(node=peer.idx, round=self.round, kind="join"))
+        if self._tracer.enabled:
+            self._tracer.count("peer_join")
+        # Answer while learning OR after the run ended: a joiner that
+        # dials in after the last round would otherwise wait forever
+        # for a model that nobody is going to push. A finished node
+        # replies with its FINAL state (round == total_rounds), so the
+        # late joiner adopts the converged model, fast-forwards past
+        # the whole schedule, and terminates immediately.
+        if self.initialized and (self.learning or self.finished.is_set()):
+            task = asyncio.create_task(self._send_state_sync(peer))
+            self._tasks.append(task)
+            task.add_done_callback(
+                lambda t: self._tasks.remove(t) if t in self._tasks else None
+            )
+
+    async def _send_state_sync(self, peer: PeerState) -> None:
+        """Answer a joiner's hello with the current global model in
+        CHECKPOINT format (federation.checkpoint.pack_model — the join
+        path and the restart-from-disk path share one serialization)
+        plus the run parameters it needs to fast-forward."""
+        from p2pfl_tpu.federation.checkpoint import pack_model
+
+        with self._tracer.span("p2p.state_sync", lane=self._lane,
+                               args={"peer": peer.idx,
+                                     "round": self.round}):
+            blob = pack_model(self.learner.get_parameters(), self.round)
+            msg = self._sign(
+                Message(MsgType.STATE_SYNC, self.idx,
+                        {"round": self.round,
+                         "rounds": self.total_rounds,
+                         "epochs": self.epochs,
+                         "leader": self.leader},
+                        payload=blob)
+            )
+            try:
+                await self._write(peer, msg)
+            except (ConnectionError, RuntimeError):
+                self._drop_conn(peer)
 
     def _record_peer_wire(self, hello: Message) -> None:
         """Remember the wire precisions the peer's CONNECT hello
@@ -487,6 +617,29 @@ class P2PNode:
                 with contextlib.suppress(ValueError):
                     peer.send_q.task_done()
 
+    def _teardown_conn(self, conn: PeerState) -> None:
+        """Full lane teardown (send task included — an orphaned drain
+        task parked on get() would outlive the run)."""
+        self._drop_conn(conn)
+        if conn.reader_task:
+            conn.reader_task.cancel()
+        conn.writer.close()
+
+    def _evict_dead(self, node: int) -> None:
+        """Reconnect budget exhausted: the crash is final as far as
+        this node is concerned — same teardown as an explicit STOP, so
+        round barriers and gossip stop waiting on the corpse. A later
+        live re-join ("jr" hello) clears the sticky departure."""
+        log.info("node %d evicting unreachable peer %d", self.idx, node)
+        if self._tracer.enabled:
+            self._tracer.count("peer_evicted")
+        self.membership.evict(node)
+        self.progress.pop(node, None)
+        self.peer_roles.pop(node, None)
+        conn = self.peers.pop(node, None)
+        if conn is not None:
+            self._teardown_conn(conn)
+
     async def _drain_send_q(self, peer: PeerState) -> None:
         """Backpressure writer for one connection: drains the peer's
         bounded send queue in FIFO order. The queue only sees traffic
@@ -579,7 +732,8 @@ class P2PNode:
             if not damped:
                 await self._forward(msg, exclude=peer.idx,
                                     limit=self.protocol.gossip_fanout)
-        elif msg.type is MsgType.PARAMS and not self._verify_origin(msg):
+        elif (msg.type in (MsgType.PARAMS, MsgType.STATE_SYNC)
+              and not self._verify_origin(msg)):
             return
         t = msg.type
         if t is MsgType.BEAT:
@@ -616,14 +770,11 @@ class P2PNode:
             self.peer_roles.pop(gone_id, None)
             conn = self.peers.pop(gone_id, None)
             if conn is not None:
-                # full lane teardown (send task included — an orphaned
-                # drain task parked on get() would outlive the run)
-                self._drop_conn(conn)
-                if conn.reader_task:
-                    conn.reader_task.cancel()
-                conn.writer.close()
+                self._teardown_conn(conn)
         elif t is MsgType.PARAMS:
             await self._on_params(peer, msg)
+        elif t is MsgType.STATE_SYNC:
+            await self._on_state_sync(msg)
         elif t is MsgType.MODELS_AGGREGATED:
             # monotonic like MODELS_READY: flood paths (and post-
             # eviction replays) can deliver an older snapshot after a
@@ -696,7 +847,37 @@ class P2PNode:
             self._pending_params.append((peer, msg))
             return
         if msg_round < self.round:
-            return  # stale leftover from a finished round
+            # Async elasticity (round 11): a straggler's update for a
+            # RECENT round folds into the current session with a
+            # staleness-discounted weight (1/(1+s)^beta, applied inside
+            # add_model) instead of being dropped — FedBuff-style late
+            # inclusion. Only raw contributions qualify: a stale FULL
+            # aggregate is last round's RESULT, and adopting it would
+            # instantly cover the fresh session and erase this round's
+            # training (the exact hazard the round fence exists for).
+            staleness = self.round - msg_round
+            if (self.session.async_mode and self._round_active
+                    and not self.session.waiting
+                    and not msg.body.get("aggregated")):
+                payload = decode_parameters(msg.payload)
+                contribs = frozenset(payload.contributors)
+                ts = self.session.train_set
+                if contribs and not (ts and contribs >= ts):
+                    covered = self.session.add_model(
+                        payload.params, payload.contributors,
+                        payload.weight, staleness=staleness,
+                    )
+                    if self._tracer.enabled:
+                        self._tracer.count("stale_params_folded")
+                    if covered:
+                        await self.broadcast(
+                            Message(
+                                MsgType.MODELS_AGGREGATED, self.idx,
+                                {"contributors": sorted(covered),
+                                 "round": self.round},
+                            )
+                        )
+            return
         if self.session.waiting and not msg.body.get("aggregated"):
             return  # waiting nodes adopt only a *finished* aggregate
         payload = decode_parameters(msg.payload)
@@ -710,6 +891,59 @@ class P2PNode:
                     {"contributors": sorted(covered), "round": self.round},
                 )
             )
+
+    async def _on_state_sync(self, msg: Message) -> None:
+        """Joiner side of the live-join handshake: adopt the
+        established node's model (checkpoint format) and fast-forward
+        to its round, then enter the running federation. Only declared
+        joiners act on STATE_SYNC, the round fast-forward never rewinds,
+        and the model is adopted at most once (first answer wins — the
+        init-params catch-up from _sync_peer may already have landed)."""
+        if not self.joiner:
+            return
+        rnd = int(msg.body.get("round", 0))
+        with self._tracer.span("p2p.join", lane=self._lane,
+                               args={"round": rnd, "from": msg.sender}):
+            if rnd > self.round:
+                if self.learning:
+                    # defer for the WHOLE round body, not just the
+                    # active-session window: _train_round awaits in its
+                    # vote phase before _round_active is set, and a
+                    # direct jump there would let the body's trailing
+                    # round increment skip past the jump target. The
+                    # learning loop applies the target at the next
+                    # round boundary.
+                    self._join_round_target = max(
+                        self._join_round_target or 0, rnd)
+                else:
+                    self.round = rnd
+            if not self.initialized:
+                ln = self.learner
+                if (getattr(ln, "state", True) is None
+                        or getattr(ln, "fns", True) is None):
+                    ln.init()
+                from p2pfl_tpu.federation.checkpoint import unpack_model
+
+                try:
+                    params, _ = unpack_model(
+                        msg.payload, ln.get_parameters())
+                except ValueError:
+                    log.warning(
+                        "node %d: STATE_SYNC blob from %d does not "
+                        "match the local model", self.idx, msg.sender)
+                    return
+                ln.set_parameters(params)
+                self.initialized = True
+                await self.broadcast(
+                    Message(MsgType.MODEL_INITIALIZED, self.idx))
+            if self._tracer.enabled:
+                self._tracer.count("join_state_sync")
+            if not self.learning and not self.finished.is_set():
+                self._start_learning(
+                    int(msg.body.get("rounds", 0)),
+                    int(msg.body.get("epochs", 1)),
+                    leader=msg.body.get("leader"),
+                )
 
     # ------------------------------------------------------------------
     # send path
@@ -955,7 +1189,55 @@ class P2PNode:
                     Message(MsgType.ROLE, self.idx, {"role": self.role})
                 )
             self.membership.advance_to(self.membership.clock + period)
+            await self._probe_suspects()
             await asyncio.sleep(period)
+
+    async def _probe_suspects(self) -> None:
+        """Actual peer-death detection (round 11): probe each SUSPECT
+        (heartbeat-timed-out; NODE_DIED already fired) whose backoff
+        window elapsed. A real process death closes its sockets, so by
+        the time heartbeat silence is noticed the read loop has already
+        dropped the peer entry — redial, and membership clears the
+        suspicion on the replacement's first beat. A STILL-registered
+        open lane is the opposite case: heartbeat silence there is far
+        more often event-loop lag (CPU-bound fits starve the loop in
+        packed layouts) than death, and tearing down a healthy lane
+        drops in-flight round traffic — so leave it alone and only burn
+        a retry, which keeps a genuinely wedged-but-open connection on
+        the same bounded path to eviction. Once the retry budget is
+        exhausted the death goes sticky (_evict_dead)."""
+        for node in self.membership.probes_due():
+            conn = self.peers.get(node)
+            if conn is not None and not conn.writer.is_closing():
+                if self.membership.probe_failed(node):
+                    self._evict_dead(node)
+                elif self._tracer.enabled:
+                    self._tracer.count("probe_defer")
+                continue
+            addr = self._peer_addrs.get(node)
+            ok = False
+            if addr is not None:
+                if conn is not None:
+                    # lane already closing: finish the teardown so the
+                    # redial replaces it instead of racing it
+                    self._teardown_conn(conn)
+                with self._tracer.span("p2p.probe", lane=self._lane,
+                                       args={"peer": node}):
+                    try:
+                        await asyncio.wait_for(
+                            self.connect_to(*addr),
+                            timeout=self.protocol.heartbeat_period_s,
+                        )
+                        ok = True
+                    except Exception:
+                        ok = False
+            if ok:
+                if self._tracer.enabled:
+                    self._tracer.count("probe_ok")
+            elif self.membership.probe_failed(node):
+                self._evict_dead(node)
+            elif self._tracer.enabled:
+                self._tracer.count("probe_fail")
 
     # ------------------------------------------------------------------
     # learning
@@ -1107,7 +1389,10 @@ class P2PNode:
 
     async def _learning_loop(self) -> None:
         ln = self.learner
-        ln.set_epochs(self.epochs)
+        # per-node profile (round 11): a compute-class epochs override
+        # beats the federation-wide START_LEARNING value
+        ln.set_epochs(self.local_epochs
+                      if self.local_epochs is not None else self.epochs)
         if getattr(ln, "state", True) is None or getattr(ln, "fns", True) is None:
             ln.init()
         if self.initialized:
@@ -1118,6 +1403,14 @@ class P2PNode:
                 await asyncio.sleep(self.gossip_period_s)
         self.learn_t0 = time.monotonic()
         while self.round < self.total_rounds:
+            if self._join_round_target is not None:
+                # deferred join fast-forward (STATE_SYNC landed while a
+                # round body was active): jump at the boundary, where
+                # no session references the old round number
+                self.round = max(self.round, self._join_round_target)
+                self._join_round_target = None
+                if self.round >= self.total_rounds:
+                    break
             t0 = time.monotonic()
             with self._tracer.span("node.round", lane=self._lane,
                                    args={"round": self.round}):
@@ -1178,11 +1471,22 @@ class P2PNode:
     async def _fit(self) -> None:
         """Local training off the event loop: a blocking device call in
         line would starve heartbeats/gossip for the whole epoch and get
-        peers evicted by membership timeouts."""
+        peers evicted by membership timeouts.
+
+        ``fit_slowdown`` (heterogeneous compute classes, round 11)
+        stretches the fit by sleeping ``elapsed * (k - 1)`` AFTER the
+        real fit: a straggler is exactly k× its own natural speed, with
+        no absolute-time guess that would drift across models/hosts —
+        and the sleep yields the loop, so heartbeats keep flowing."""
+        t0 = time.monotonic()
         with self._tracer.span("node.fit", lane=self._lane,
                                args={"round": self.round}):
             await asyncio.get_running_loop().run_in_executor(
                 None, self.learner.fit
+            )
+        if self.fit_slowdown > 1.0:
+            await asyncio.sleep(
+                (time.monotonic() - t0) * (self.fit_slowdown - 1.0)
             )
 
     def round_p95_s(self) -> float | None:
@@ -1368,10 +1672,25 @@ class P2PNode:
             # no STOP) must stop consuming fanout slots and proxy
             # bandwidth even while a proxy path to its address exists.
             live = set(self.membership.get_nodes())
+            # In async mode a peer stops being a gossip target once its
+            # coverage meets the QUORUM its own session closes on: full
+            # train-set coverage is unreachable whenever a voted member
+            # crashed mid-round, and chasing it would pin every round
+            # at the aggregation deadline — exactly the serialization
+            # the buffered session exists to remove. Sync mode keeps
+            # the full-coverage bar (quorum is the whole train set).
+            quorum = (self.session.quorum()
+                      if self.session.async_mode else None)
+
+            def _stale_target(has: set[int]) -> bool:
+                if train_set <= has:
+                    return False
+                return quorum is None or len(has & train_set) < quorum
+
             targets = [
                 (i, self._aggregated_by(i))
                 for i in sorted((aggregators - {self.idx}) & live)
-                if not (train_set <= self._aggregated_by(i))
+                if _stale_target(self._aggregated_by(i))
                 and (i in self.peers or proxies)
             ]
             if (done and not targets) or loop.time() > deadline:
@@ -1448,16 +1767,28 @@ class P2PNode:
     async def _wait_neighbors_ready(self) -> None:
         """Round barrier: wait until every alive node we've heard from
         reports this round (MODELS_READY gating, node.py:713; floods,
-        so multi-hop members count too), bounded by the timeout."""
+        so multi-hop members count too), bounded by the timeout.
+
+        In async mode the barrier relaxes to the SAME quorum the
+        session closes on: waiting for every straggler here would
+        re-serialize the rounds the buffered aggregation just
+        de-serialized — the whole async speedup would die at the
+        barrier. Stragglers left behind catch up via the stale-params
+        fold (see _on_params)."""
         deadline = asyncio.get_event_loop().time() + self.session.timeout_s
+        frac = self.session.min_received
         while asyncio.get_event_loop().time() < deadline:
             alive = set(self.membership.get_nodes())
             known = set(self.peers) | set(self.progress)
+            others = [i for i in alive & known if i != self.idx]
             behind = [
-                i for i in alive & known
-                if i != self.idx
-                and self._progress(i).ready_round < self.round
+                i for i in others
+                if self._progress(i).ready_round < self.round
             ]
             if not behind:
                 return
+            if self.session.async_mode and others:
+                need = max(1, math.ceil(frac * len(others)))
+                if len(others) - len(behind) >= need:
+                    return
             await asyncio.sleep(self.gossip_period_s)

@@ -79,6 +79,14 @@ class MsgType(enum.Enum):
     # direct messages
     CONNECT = "connect"
     PARAMS = "params"
+    # round 11 live join: an established node answers a joiner's
+    # CONNECT hello (body["jr"] — joining, knows round N) with the
+    # current global model in CHECKPOINT format
+    # (federation.checkpoint.pack_model), so the join path and the
+    # restart-from-disk path share one serialization. Direct, never
+    # relayed: the payload is a full model and the joiner asked one
+    # specific peer.
+    STATE_SYNC = "state_sync"
 
 
 GOSSIPED = frozenset(

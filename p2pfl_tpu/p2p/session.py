@@ -16,6 +16,21 @@ asyncio.Event instead of a daemon thread blocking on a lock (:40-49):
 - ``waiting`` mode (TRAINER/PROXY/IDLE, :93-123): the first full
   aggregate that arrives is adopted as-is.
 
+Round 11 adds a buffered **async mode** (``min_received < 1``, the
+FedBuff-style close rule): the round closes as soon as a quorum of the
+expected train set is covered — or the deadline fires — instead of
+waiting for everyone; a straggler's update that misses the close is
+not dropped but folded into the NEXT round's aggregate with its weight
+discounted by ``1/(1+staleness)^beta``
+(p2pfl_tpu.parallel.federated.staleness_scale — the same host-side f32
+formula the SPMD plane applies as a mix-column scale, so the two
+planes' weighting stays bit-comparable). The discount is applied to
+the entry's WEIGHT at add time: staleness is a property of the update
+itself, so scaling once at the entry point composes correctly with
+partial-aggregation forwarding (weighted means carry weights) and
+never compounds, unlike reputation scaling which is receiver-context
+and therefore applies only at finish.
+
 The math is the pure aggregator from p2pfl_tpu.core.aggregators over a
 stacked tree — device-jittable even in the socket path.
 """
@@ -23,6 +38,7 @@ stacked tree — device-jittable even in the socket path.
 from __future__ import annotations
 
 import asyncio
+import math
 import time
 from typing import Any
 
@@ -32,6 +48,7 @@ import numpy as np
 from p2pfl_tpu.core.aggregators import Aggregator, FedAvg
 from p2pfl_tpu.core.pytree import tree_stack
 from p2pfl_tpu.obs.trace import get_tracer
+from p2pfl_tpu.parallel.federated import staleness_scale
 
 Params = Any
 
@@ -41,9 +58,15 @@ class AggregationSession:
 
     def __init__(self, aggregator: Aggregator | None = None,
                  timeout_s: float = 60.0, reputation=None,
-                 lane: str | None = None):
+                 lane: str | None = None, min_received: float = 1.0,
+                 staleness_beta: float = 0.0):
         self.aggregator = aggregator or FedAvg()
         self.timeout_s = timeout_s  # AGGREGATION_TIMEOUT
+        #: async close quorum as a fraction of the expected train set;
+        #: 1.0 = classic synchronous behavior (full coverage or timeout)
+        self.min_received = float(min_received)
+        #: staleness discount exponent (0 = stale entries weigh fresh)
+        self.staleness_beta = float(staleness_beta)
         # obs: the owning node's trace lane (k nodes share a process
         # tracer in packed launch layouts — the lane attributes spans)
         self._tracer = get_tracer()
@@ -95,11 +118,36 @@ class AggregationSession:
     def timed_out(self) -> bool:
         return self._deadline is not None and time.monotonic() > self._deadline
 
+    @property
+    def async_mode(self) -> bool:
+        return self.min_received < 1.0
+
+    def quorum(self) -> int:
+        """Entries-covered threshold that closes an async round."""
+        n = len(self.train_set)
+        if not self.async_mode:
+            return n
+        return max(1, math.ceil(self.min_received * n))
+
+    def quorum_met(self) -> bool:
+        return bool(self.train_set) and (
+            len(self.covered & self.train_set) >= self.quorum()
+        )
+
     # -- adding models ---------------------------------------------------
-    def add_model(self, params: Params, contributors, weight: float) -> tuple[int, ...]:
+    def add_model(self, params: Params, contributors, weight: float,
+                  staleness: float = 0.0) -> tuple[int, ...]:
         """Returns the contributors now covered (broadcast as
-        MODELS_AGGREGATED, node.py:363-369). Empty tuple = rejected."""
+        MODELS_AGGREGATED, node.py:363-369). Empty tuple = rejected.
+
+        ``staleness`` (rounds-behind, async mode) discounts the entry's
+        weight by ``staleness_scale`` at entry time — see module doc.
+        """
         with self._tracer.span("session.add_model", lane=self._lane):
+            if staleness > 0.0 and self.staleness_beta > 0.0:
+                weight = float(weight) * float(
+                    staleness_scale(staleness, self.staleness_beta)
+                )
             return self._add_model(params, contributors, weight)
 
     def _add_model(self, params: Params, contributors,
@@ -128,7 +176,10 @@ class AggregationSession:
             del self.models[key]
         self.models[contrib] = (params, float(weight))
         self._partial_memo.clear()  # store changed; memoed partials stale
-        if self.train_set and self.covered >= self.train_set:
+        if self.train_set and (
+            self.covered >= self.train_set
+            or (self.async_mode and self.quorum_met())
+        ):
             self._finish()
         return tuple(sorted(self.covered))
 
@@ -160,12 +211,13 @@ class AggregationSession:
 
     # -- completion -------------------------------------------------------
     def check_and_run(self) -> bool:
-        """Called by the node loop: finish on coverage or timeout with
-        whatever arrived (aggregator.py:53-76)."""
+        """Called by the node loop: finish on coverage (async: quorum)
+        or timeout with whatever arrived (aggregator.py:53-76)."""
         if self.done.is_set():
             return True
         if self.models and (
             (self.train_set and self.covered >= self.train_set)
+            or (self.async_mode and self.quorum_met())
             or self.timed_out()
         ):
             self._finish()

@@ -102,6 +102,21 @@ def make_mixing_matrix(topology: Topology, scheme: str = "uniform") -> np.ndarra
     return topology.mixing_matrix(scheme).astype(np.float32)
 
 
+def staleness_scale(staleness, beta: float) -> np.ndarray:
+    """Staleness discount ``1 / (1 + s)^beta`` (round 11, elastic
+    federation) — THE formula for folding late updates into an
+    aggregate, shared verbatim by both planes so their weighting is
+    bit-comparable: the socket plane applies it per-entry in
+    ``AggregationSession._aggregate``, the SPMD plane as a column scale
+    on the mixing matrix (``Scenario._plan_args``), both on the host in
+    float32. ``staleness`` is rounds-behind (0 = fresh); negative
+    values clamp to fresh; ``beta=0`` is the identity."""
+    s = np.maximum(np.asarray(staleness, np.float32), 0.0)
+    if beta == 0.0:
+        return np.ones_like(s)
+    return (1.0 / np.power(1.0 + s, np.float32(beta))).astype(np.float32)
+
+
 def _tree_sel(cond: jax.Array, a, b):
     """Per-node select: cond [n] broadcast over each stacked leaf."""
 

@@ -218,12 +218,45 @@ def test_observe_entries_attributes_partials_to_contributors():
     ]
     mon.observe_entries(ref, entries)
     assert mon.trust[0] > 0.8 and mon.trust[1] > 0.8
-    # both contributors of the anomalous partial take the hit
-    assert mon.trust[2] < 0.05 and mon.trust[3] < 0.05
+    # an anomalous partial is FULL-strength evidence against every
+    # not-yet-caught contributor: a never-observed attacker must cross
+    # the cutoff from its first bad aggregate, so both members of the
+    # merge take the whole hit (the honest one recovers next round via
+    # the explaining-away below plus the EWMA)
+    assert mon.trust[2] < 0.15 and mon.trust[3] < 0.15
+    # a singleton bad entry IS full-strength evidence
+    mon_s = ReputationMonitor(3, alpha=1.0, cutoff=0.15)
+    mon_s.observe_entries(ref, [
+        (frozenset({0}), {"w": base}),
+        (frozenset({1}), {"w": base + 0.05}),
+        (frozenset({2}), {"w": -10.0 * base}),
+    ])
+    assert mon_s.trust[2] < 0.05
+    # explaining-away: once a node is caught red-handed by a SINGLETON
+    # (direct evidence — merely-low trust is NOT enough, a transient
+    # false positive would shield the real attacker), a bad partial
+    # containing it says nothing new about its co-contributors
+    mon_x = ReputationMonitor(4, alpha=1.0, cutoff=0.15)
+    mon_x.observe_entries(ref, [
+        (frozenset({0}), {"w": base}),
+        (frozenset({1}), {"w": base + 0.05}),
+        (frozenset({2}), {"w": -10.0 * base}),  # caught red-handed
+    ])
+    assert bool(mon_x._confirmed_bad[2])
+    mon_x.observe_entries(ref, [
+        (frozenset({0}), {"w": base}),
+        (frozenset({1}), {"w": base + 0.05}),
+        (frozenset({2, 3}), {"w": -10.0 * base}),
+    ])
+    assert mon_x.trust[2] < 0.05  # known-bad node absorbs the blame
+    assert mon_x.trust[3] == 1.0  # co-contributor: no observation at all
     scales = mon.entry_scales([frozenset({0}), frozenset({0, 2}),
                               frozenset(), frozenset({9})])
     assert scales[0] == pytest.approx(mon.weights_vector()[0])
-    assert scales[1] == pytest.approx(mon.weights_vector()[[0, 2]].mean())
+    # min over contributors: one contaminated contributor voids the
+    # whole partial (here node 2 is below the cutoff, so weight 0)
+    assert scales[1] == pytest.approx(mon.weights_vector()[[0, 2]].min())
+    assert scales[1] == 0.0
     assert scales[2] == 1.0 and scales[3] == 1.0  # no evidence, no penalty
 
 

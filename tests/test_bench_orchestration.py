@@ -30,7 +30,7 @@ def test_zero_budget_still_emits_parseable_json():
     # everywhere), every phase is explicitly accounted as skipped
     assert set(out["skipped_phases"]) == {
         "headline", "cifar16", "cpu8", "socket24", "comm", "socket_mp",
-        "obs", "robust", "vit32"
+        "obs", "robust", "elastic", "vit32"
     }
 
 
@@ -108,6 +108,31 @@ def test_comm_phase_dry_run_emits_key_plan():
             "wire_bf16_round_s_24node_uncapped", "overlap_round_s",
             "overlap_rounds_to_80pct",
             "overlap_xla_recompiles"} <= planned
+    assert planned <= set(bench.BENCH_KEYS)
+
+
+def test_elastic_phase_dry_run_emits_key_plan():
+    """P2PFL_ELASTIC_DRY=1: the elastic phase must emit its planned key
+    list as one parseable part without touching jax — the round-11
+    analog of the comm dry-run hook."""
+    env = dict(os.environ, P2PFL_ELASTIC_DRY="1")
+    code = (f"import sys; sys.path.insert(0, {str(REPO)!r})\n"
+            "import bench; bench._phase_elastic()\n")
+    res = subprocess.run([sys.executable, "-c", code],
+                         capture_output=True, text=True, timeout=60,
+                         env=env, cwd=REPO)
+    assert res.returncode == 0, res.stderr[-500:]
+    sys.path.insert(0, str(REPO))
+    import bench
+
+    parts = [json.loads(line[len(bench._PART_TAG):])
+             for line in res.stdout.splitlines()
+             if line.startswith(bench._PART_TAG)]
+    assert len(parts) == 1 and parts[0]["elastic_dry"] is True
+    planned = set(parts[0]["elastic_keys"])
+    assert {"elastic_sync_wall_s", "elastic_async_wall_s",
+            "elastic_async_speedup", "elastic_churn",
+            "elastic_spmd_rounds_to_target_weighted"} <= planned
     assert planned <= set(bench.BENCH_KEYS)
 
 

@@ -1,0 +1,424 @@
+"""Elastic federation (round 11): buffered async aggregation, the
+staleness-discount shared between planes, the suspect/probe/evict
+state machine, the live-join STATE_SYNC handshake, and churn survival
+end-to-end on both planes.
+
+The socket federation tests reuse test_p2p's shared-trainer learner
+factory (same reason test_netem/test_tls do: per-test recompiles of
+n identical XLA programs waste tens of suite seconds)."""
+
+import asyncio
+import math
+
+import numpy as np
+import pytest
+
+from p2pfl_tpu.config.schema import (
+    DataConfig,
+    ElasticConfig,
+    FaultEvent,
+    ProtocolConfig,
+    ScenarioConfig,
+    TrainingConfig,
+)
+from p2pfl_tpu.core.aggregators import FedAvg
+from p2pfl_tpu.federation.checkpoint import pack_model
+from p2pfl_tpu.federation.events import Events
+from p2pfl_tpu.federation.membership import Membership
+from p2pfl_tpu.learning import JaxLearner
+from p2pfl_tpu.p2p import AggregationSession, Message, MsgType, P2PNode
+from p2pfl_tpu.parallel.federated import staleness_scale
+
+from test_p2p import _make_learners, _shared_trainer
+
+
+def _params(v):
+    return {"w": np.full((3,), v, np.float32)}
+
+
+# ---------------------------------------------------------------------------
+# buffered async session: quorum close rule + staleness-discounted entries
+# ---------------------------------------------------------------------------
+
+
+class TestAsyncSession:
+    def test_quorum_closes_round_before_full_coverage(self):
+        s = AggregationSession(FedAvg(), timeout_s=60, min_received=0.5)
+        s.set_nodes_to_aggregate({0, 1, 2, 3})
+        assert s.async_mode and s.quorum() == 2
+        s.add_model(_params(1.0), (0,), 1)
+        assert not s.done.is_set()
+        s.add_model(_params(3.0), (1,), 1)
+        assert s.done.is_set()  # FedBuff-style close at ceil(0.5 * 4)
+        params, contribs = s.result
+        assert contribs == (0, 1)
+        np.testing.assert_allclose(params["w"], 2.0)
+
+    def test_sync_mode_quorum_is_full_coverage(self):
+        s = AggregationSession(FedAvg(), timeout_s=60)
+        s.set_nodes_to_aggregate({0, 1, 2, 3})
+        assert not s.async_mode
+        assert s.quorum() == 4
+        s.add_model(_params(1.0), (0,), 1)
+        s.add_model(_params(1.0), (1,), 1)
+        assert not s.done.is_set()  # half the set is NOT enough in sync
+
+    def test_staleness_discount_matches_shared_formula(self):
+        """The entry-weight discount must be staleness_scale — the SAME
+        host-side f32 formula the SPMD plane applies as a mix column,
+        so the two planes' weighting is bit-comparable."""
+        beta = 0.5
+        d = float(staleness_scale(3.0, beta))  # rounds-behind = 3
+        s = AggregationSession(FedAvg(), timeout_s=60, staleness_beta=beta)
+        s.set_nodes_to_aggregate({0, 1})
+        s.add_model(_params(0.0), (0,), 1)
+        s.add_model(_params(3.0), (1,), 1, staleness=3.0)
+        params, contribs = s.result
+        assert contribs == (0, 1)
+        np.testing.assert_allclose(
+            params["w"], 3.0 * d / (1.0 + d), rtol=1e-6
+        )
+
+    def test_beta_zero_is_identity(self):
+        s = AggregationSession(FedAvg(), timeout_s=60, staleness_beta=0.0)
+        s.set_nodes_to_aggregate({0, 1})
+        s.add_model(_params(0.0), (0,), 1)
+        s.add_model(_params(4.0), (1,), 1, staleness=5.0)
+        np.testing.assert_allclose(s.result[0]["w"], 2.0)
+
+
+# ---------------------------------------------------------------------------
+# suspect/probe/evict state machine (socket-plane peer-death detection)
+# ---------------------------------------------------------------------------
+
+
+class TestMembershipProbeMachine:
+    def _machine(self):
+        proto = ProtocolConfig(heartbeat_period_s=0.2, node_timeout_s=1.0)
+        m = Membership(4, proto, virtual=False, retry_limit=3,
+                       backoff_base_s=0.5, backoff_max_s=8.0)
+        events = []
+        m.add_observer(lambda e, p: events.append((e, p["node"])))
+        for i in range(4):
+            m.beat(i, t=0.0)
+        return m, events
+
+    def test_timeout_probe_backoff_then_sticky_evict(self):
+        m, events = self._machine()
+        for i in range(3):
+            m.beat(i, t=2.0)
+        m.advance_to(2.5)  # node 3 silent past node_timeout_s
+        assert m.get_nodes() == [0, 1, 2]
+        assert (Events.NODE_DIED, 3) in events
+        # suspect window opens one backoff base after detection
+        assert m.probes_due(2.9) == []
+        assert m.probes_due(3.0) == [3]
+        # exponential backoff: k-th failure reschedules at base * 2^k
+        assert m.probe_failed(3, t=3.0) is False
+        assert m.probes_due(3.9) == []
+        assert m.probes_due(4.0) == [3]  # +base*2
+        assert m.probe_failed(3, t=4.0) is False
+        assert m.probes_due(5.9) == []
+        assert m.probes_due(6.0) == [3]  # +base*4
+        # retry budget exhausted: the caller must evict
+        assert m.probe_failed(3, t=6.0) is True
+        m.evict(3)
+        assert m.departed[3] and m.probes_due(100.0) == []
+        # sticky: a straggler beat must not resurrect a departed node
+        m.beat(3, t=7.0)
+        assert m.get_nodes() == [0, 1, 2]
+
+    def test_backoff_caps_at_max(self):
+        proto = ProtocolConfig(heartbeat_period_s=0.2, node_timeout_s=1.0)
+        m = Membership(2, proto, virtual=False, retry_limit=10,
+                       backoff_base_s=0.5, backoff_max_s=1.0)
+        m.beat(0, t=0.0)
+        m.advance_to(2.0)  # node 1 never beat
+        m.probe_failed(1, t=2.0)
+        m.probe_failed(1, t=3.0)  # base*4 = 2.0 would exceed the cap
+        assert m.next_probe[1] == pytest.approx(4.0)  # t + cap, not + 2.0
+
+    def test_join_fault_clears_sticky_departure(self):
+        m, events = self._machine()
+        m.evict(3)
+        assert m.departed[3]
+        m.apply_fault(FaultEvent(node=3, round=2, kind="join"))
+        assert not m.departed[3]
+        assert 3 in m.get_nodes()
+        assert (Events.NODE_JOINED, 3) in events
+        assert (Events.NODE_RECOVERED, 3) in events
+
+    def test_recovery_before_final_evict_clears_suspicion(self):
+        m, events = self._machine()
+        m.advance_to(2.0)  # everyone silent -> all suspect
+        assert m.get_nodes() == []
+        m.probe_failed(1, t=2.0)  # one failed probe, budget remains
+        m.beat(1, t=2.5)
+        assert 1 in m.get_nodes()
+        assert int(m.probe_failures[1]) == 0  # suspicion fully cleared
+        assert (Events.NODE_RECOVERED, 1) in events
+
+
+# ---------------------------------------------------------------------------
+# live-join handshake: "jr" hello + STATE_SYNC model adoption
+# ---------------------------------------------------------------------------
+
+
+def _node(idx, learner, proto, **kw):
+    return P2PNode(idx, learner, role="aggregator", n_nodes=2,
+                   protocol=proto, gossip_period_s=0.02, **kw)
+
+
+_PROTO = ProtocolConfig(heartbeat_period_s=0.2, aggregation_timeout_s=15.0,
+                        vote_timeout_s=3.0, node_timeout_s=1.0)
+
+
+class TestStateSyncHandshake:
+    def test_hello_advertises_join_round_only_for_joiners(self):
+        async def main():
+            _, learners = _make_learners(2, samples=60)
+            a = _node(0, learners[0], _PROTO)
+            b = _node(1, learners[1], _PROTO, joiner=True)
+            b.round = 2
+            assert "jr" not in a._hello_body()
+            assert b._hello_body()["jr"] == 2
+
+        asyncio.run(main())
+
+    def test_state_sync_adopts_model_round_and_learning(self):
+        """Deterministic handshake check: feed the joiner a crafted
+        STATE_SYNC directly (no network, no _sync_peer race) and assert
+        it adopts the model bytes, fast-forwards, and starts learning
+        with the sender's schedule."""
+
+        async def main():
+            _, learners = _make_learners(2, samples=60)
+            src = learners[0]
+            src.init()
+            b = _node(1, learners[1], _PROTO, joiner=True)
+            await b.start()
+            try:
+                blob = pack_model(src.get_parameters(), 3)
+                msg = Message(
+                    MsgType.STATE_SYNC, 0,
+                    {"round": 3, "rounds": 5, "epochs": 2, "leader": 0},
+                    payload=blob,
+                )
+                await b._on_state_sync(msg)
+                assert b.round == 3
+                assert b.initialized and b.learning
+                assert b.total_rounds == 5 and b.epochs == 2
+                for x, y in zip(
+                    np.asarray(src.get_parameters()["params"]["Dense_0"]
+                               ["kernel"]).ravel(),
+                    np.asarray(b.learner.get_parameters()["params"]
+                               ["Dense_0"]["kernel"]).ravel(),
+                ):
+                    assert x == y  # exact byte adoption, no re-init
+            finally:
+                await b.stop()
+
+        asyncio.run(main())
+
+    def test_state_sync_defers_jump_while_learning(self):
+        """A fast-forward landing while ANY part of a round body is in
+        flight (vote, fit, barrier) must not yank self.round out from
+        under it — the body's trailing increment would skip past the
+        target. It parks in _join_round_target and the learning loop
+        applies it at the round boundary."""
+
+        async def main():
+            _, learners = _make_learners(2, samples=60)
+            src = learners[0]
+            src.init()
+            b = _node(1, learners[1], _PROTO, joiner=True)
+            await b.start()
+            try:
+                b.learning = True  # a second sync landing mid-round
+                msg = Message(
+                    MsgType.STATE_SYNC, 0,
+                    {"round": 4, "rounds": 6, "epochs": 1, "leader": 0},
+                    payload=pack_model(src.get_parameters(), 4),
+                )
+                await b._on_state_sync(msg)
+                assert b.round == 0  # not yanked mid-round
+                assert b._join_round_target == 4
+                assert b.initialized  # the model still lands at once
+            finally:
+                await b.stop()
+
+        asyncio.run(main())
+
+
+# ---------------------------------------------------------------------------
+# end-to-end churn survival, both planes
+# ---------------------------------------------------------------------------
+
+
+async def _until(cond, timeout, period=0.05):
+    deadline = asyncio.get_event_loop().time() + timeout
+    while not cond():
+        if asyncio.get_event_loop().time() > deadline:
+            raise AssertionError("condition not reached in time")
+        await asyncio.sleep(period)
+
+
+def test_crash_evict_rejoin_async_federation():
+    """The ISSUE's headline robustness property on real sockets: crash
+    a node mid-round WITHOUT a STOP flood; the async quorum keeps
+    rounds closing, heartbeat-timeout probes evict the corpse, and a
+    fresh joiner process re-enters through the "jr" hello + STATE_SYNC
+    fetch and finishes the run converged with the cohort.
+
+    Accuracy (not param equality) is the convergence check: async
+    nodes close at their own quorums, so finals differ by design."""
+
+    async def main():
+        n = 4
+        fed, learners = _make_learners(n, samples=120)
+        el = ElasticConfig(async_aggregation=True, min_received=0.5,
+                           staleness_beta=0.5,
+                           heartbeat_backoff_base_s=0.1,
+                           heartbeat_backoff_max_s=0.5)
+        nodes = [
+            P2PNode(i, learners[i], role="aggregator", n_nodes=n,
+                    protocol=_PROTO, gossip_period_s=0.02, elastic=el)
+            for i in range(n)
+        ]
+        joiner = None
+        try:
+            for node in nodes:
+                await node.start()
+            for i in range(n):
+                for j in range(i + 1, n):
+                    await nodes[i].connect_to(nodes[j].host, nodes[j].port)
+            nodes[0].learner.init()
+            nodes[0].set_start_learning(rounds=6, epochs=1)
+
+            await _until(lambda: nodes[3].round >= 1, 60)
+            await nodes[3].crash()  # abrupt: no STOP, sockets just die
+
+            # heartbeat timeout -> backoff probes -> sticky eviction,
+            # at every survivor
+            await _until(
+                lambda: all(bool(nd.membership.departed[3])
+                            for nd in nodes[:3]), 30)
+            assert all(3 not in nd.membership.get_nodes()
+                       for nd in nodes[:3])
+
+            # re-join with a FRESH learner the moment eviction lands:
+            # params must come from the cohort via STATE_SYNC, not
+            # local state. (Quorum rounds close fast, so the join may
+            # land mid-run or right at the end — BOTH must produce an
+            # initialized, converged, finished joiner.)
+            ln = JaxLearner(model=None, data=fed.nodes[3],
+                            learning_rate=0.05, seed=0,
+                            trainer=_shared_trainer())
+            joiner = P2PNode(3, ln, role="aggregator", n_nodes=n,
+                             protocol=_PROTO, gossip_period_s=0.02,
+                             elastic=el, joiner=True)
+            await joiner.start()
+            for i in range(3):
+                await joiner.connect_to(nodes[i].host, nodes[i].port)
+
+            await asyncio.wait_for(
+                asyncio.gather(*(nd.finished.wait() for nd in nodes[:3]),
+                               joiner.finished.wait()),
+                timeout=120,
+            )
+            # the round the crash interrupted still closed (async
+            # quorum), and every survivor ran the full schedule
+            assert all(nd.round == 6 for nd in nodes[:3])
+            assert joiner.initialized and joiner.round == 6
+            assert joiner.learner.evaluate()["accuracy"] > 0.5
+            # the "jr" hello cleared the sticky departure everywhere
+            assert all(3 in nd.membership.get_nodes() for nd in nodes[:3])
+        finally:
+            for nd in nodes[:3]:
+                await nd.stop()
+            if joiner is not None:
+                await joiner.stop()
+
+    asyncio.run(main())
+
+
+def test_run_simulation_declarative_churn():
+    """Scripted churn end-to-end through the config layer: ElasticConfig
+    fractions materialize into per-node profiles + FaultEvents in
+    __post_init__, and run_simulation drives crash/evict/rejoin without
+    any hand-written orchestration."""
+    from p2pfl_tpu.p2p.launch import run_simulation
+
+    cfg = ScenarioConfig(
+        name="elastic-sim", n_nodes=4, topology="fully",
+        data=DataConfig(dataset="mnist", samples_per_node=60),
+        training=TrainingConfig(rounds=3, epochs_per_round=1,
+                                learning_rate=0.05),
+        protocol=ProtocolConfig(heartbeat_period_s=0.2,
+                                aggregation_timeout_s=15.0,
+                                vote_timeout_s=3.0, node_timeout_s=1.5),
+        elastic=ElasticConfig(async_aggregation=True, min_received=0.5,
+                              staleness_beta=0.5,
+                              heartbeat_backoff_base_s=0.1,
+                              heartbeat_backoff_max_s=0.5,
+                              straggler_fraction=0.25,
+                              straggler_factor=2.0,
+                              churn_fraction=0.25),
+    )
+    # the fractions materialized: one straggler, one churner, disjoint
+    slow = [i for i, nc in enumerate(cfg.nodes) if nc.fit_slowdown > 1.0]
+    crashed = sorted({f.node for f in cfg.faults if f.kind == "crash"})
+    assert len(slow) == 1 and len(crashed) == 1 and slow != crashed
+
+    out = run_simulation(cfg, timeout=240)
+    assert out["rounds"] == 3  # churn did not wedge the federation
+    churn = out["churn"]
+    assert churn["async"] is True
+    assert churn["crashes"] == crashed
+    assert churn["joined"] == crashed  # every crasher re-joined live
+    assert churn["stragglers"] == slow
+    assert 0.0 < out["mean_accuracy"] <= 1.0
+
+
+def test_spmd_churn_and_staleness_parity():
+    """SPMD twin: scripted crash/join faults complete the run with the
+    joiner converged (leader-row copy = the plane's STATE_SYNC), and
+    the staleness column on the mix is BIT-IDENTICAL to the socket
+    session's entry discounts — the planes share one f32 formula."""
+    from p2pfl_tpu.federation.scenario import Scenario
+
+    cfg = ScenarioConfig(
+        name="elastic-spmd", n_nodes=4, topology="ring",
+        data=DataConfig(dataset="mnist", samples_per_node=256),
+        training=TrainingConfig(rounds=4, epochs_per_round=1,
+                                learning_rate=0.1, eval_every=1),
+        elastic=ElasticConfig(async_aggregation=True, staleness_beta=0.5,
+                              straggler_fraction=0.5,
+                              straggler_factor=3.0),
+        faults=[FaultEvent(node=2, round=1, kind="crash"),
+                FaultEvent(node=2, round=2, kind="join")],
+    )
+    scen = Scenario(cfg)
+
+    stale_rounds = np.asarray(
+        [nc.fit_slowdown - 1.0 for nc in cfg.nodes], np.float32)
+    expected = staleness_scale(stale_rounds, cfg.elastic.staleness_beta)
+    assert scen._stale_scale is not None
+    np.testing.assert_array_equal(scen._stale_scale, expected)
+    # a class-k straggler is (k-1) rounds stale; the socket session
+    # must discount such an entry by the SAME f32 value
+    for s, col in zip(stale_rounds, expected):
+        assert float(staleness_scale(float(s), 0.5)) == float(col)
+
+    res = scen.run()
+    assert res.rounds_run == 4
+    assert res.per_node_accuracy[2] > 0.5  # the joiner caught up
+
+
+def test_async_ready_barrier_quorum_math():
+    """The round barrier's relaxed quorum must equal the session's
+    close quorum — a mismatch would re-serialize async rounds."""
+    s = AggregationSession(FedAvg(), timeout_s=60, min_received=0.5)
+    for n in (2, 3, 4, 10, 24):
+        s.set_nodes_to_aggregate(set(range(n)))
+        assert s.quorum() == max(1, math.ceil(0.5 * n))

@@ -996,6 +996,15 @@ _ELASTIC_KEYS = (
     "elastic_spmd_target_accuracy",
 )
 
+# keys the obs_health phase (round 12: health plane) emits; static so
+# BENCH_KEYS and the P2PFL_HEALTH_DRY plan stay authoritative
+_HEALTH_KEYS = (
+    "obs_health_detect_dead_s", "obs_health_detect_stall_s",
+    "obs_health_round_s_on", "obs_health_round_s_off",
+    "obs_health_overhead_pct", "obs_health_rules_fired",
+    "obs_health_flight_dump_bytes",
+)
+
 # Authoritative registry of every top-level key bench can emit.
 # scripts/check_bench_keys.py asserts each one is documented in
 # docs/perf.md (§10 key reference) and that no emission site uses a
@@ -1039,6 +1048,10 @@ BENCH_KEYS = (
     "comm_dry", "comm_keys", *_COMM_KEYS,
     # elastic (round 11: churn + straggler survival)
     "elastic_dry", "elastic_keys", *_ELASTIC_KEYS,
+    # obs_health (round 12: live anomaly detection + flight recorder)
+    "obs_health_dry", "obs_health_keys", *_HEALTH_KEYS,
+    # run-metadata stamp (round 12 regression gate provenance)
+    "meta",
     # orchestration-test hook
     "selftest_key",
 )
@@ -1387,6 +1400,227 @@ def _phase_obs() -> None:
         _part(part)
 
 
+def _phase_obs_health() -> None:
+    """Health-plane detection latency + always-on overhead (round 12).
+
+    Two measurements, both CPU-backend socket federations (asyncio
+    nodes cannot share the bench chip):
+
+    (a) detection: a 24-node async federation with one injected
+        straggler (round stall) and one scripted crash, watched by a
+        persistent ``obs.health.HealthEngine`` polling the status dir
+        — exactly what ``python -m p2pfl_tpu.obs.healthcheck --watch``
+        runs. Emits the silence→alarm latency for the crashed node
+        (``obs_health_detect_dead_s``: dominated by the configured
+        liveness window, which is the operational knob) and the
+        observable-lag→alarm latency for the stall
+        (``obs_health_detect_stall_s``: the rule engine's own delay,
+        measured against an independent raw-status poll).
+
+    (b) overhead: the obs phase's 8-node config, interleaved A/B via
+        ``_ab_interleaved`` — arm ON = flight recorder on + status
+        publishing + a live health watcher thread; arm OFF =
+        ``P2PFL_FLIGHT=0`` and no log_dir. Gates the <2% always-on
+        budget (docs/observability.md).
+
+    ``P2PFL_HEALTH_DRY=1`` emits the key plan without touching any
+    accelerator — the orchestration test's smoke hook."""
+    if os.environ.get("P2PFL_HEALTH_DRY") == "1":
+        _part({"obs_health_dry": True,
+               "obs_health_keys": list(_HEALTH_KEYS)})
+        return
+
+    import re
+    import tempfile
+
+    os.environ["XLA_FLAGS"] = re.sub(
+        r"--xla_force_host_platform_device_count=\d+", "",
+        os.environ.get("XLA_FLAGS", "")).strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from p2pfl_tpu.config.schema import (
+        DataConfig,
+        ElasticConfig,
+        FaultEvent,
+        ProtocolConfig,
+        ScenarioConfig,
+        TrainingConfig,
+    )
+    from p2pfl_tpu.obs import flight
+    from p2pfl_tpu.obs.health import HealthConfig, HealthEngine, evaluate_dir
+    from p2pfl_tpu.p2p.launch import run_simulation
+    from p2pfl_tpu.utils.monitor import read_statuses
+
+    part: dict = {}
+
+    # ---- (a) detection latency on the injected-fault 24-node run -----
+    STRAGGLER, CRASHED = 1, 2  # node 0 starts learning — leave it be
+    LIVENESS_S = 2.0
+
+    def det_cfg(log_dir: str) -> ScenarioConfig:
+        cfg = ScenarioConfig(
+            name="health24", n_nodes=24, topology="fully",
+            data=DataConfig(dataset="mnist", samples_per_node=30),
+            training=TrainingConfig(rounds=6, epochs_per_round=1,
+                                    learning_rate=0.05),
+            protocol=ProtocolConfig(heartbeat_period_s=0.25,
+                                    node_timeout_s=1.0,
+                                    aggregation_timeout_s=10.0,
+                                    vote_timeout_s=5.0,
+                                    train_set_size=24),
+            elastic=ElasticConfig(async_aggregation=True,
+                                  min_received=0.5, staleness_beta=0.5,
+                                  heartbeat_backoff_base_s=0.1,
+                                  heartbeat_backoff_max_s=0.5),
+            log_dir=log_dir,
+        )
+        # the straggler's fit must dwarf the ROUND time, not just its
+        # own fit (~10ms at 30 samples): async min_received lets the
+        # cohort advance, and only a fit spanning several cohort
+        # rounds produces the >=2-round lag the stall rule watches —
+        # the cohort's STOP diffusion still ends the run once its own
+        # rounds complete
+        cfg.nodes[STRAGGLER].fit_slowdown = 2000.0
+        cfg.faults.append(FaultEvent(node=CRASHED, round=1,
+                                     kind="crash"))
+        return cfg
+
+    with tempfile.TemporaryDirectory() as td:
+        sim_out: dict = {}
+
+        def run_det() -> None:
+            try:
+                sim_out.update(run_simulation(det_cfg(td), timeout=150))
+            except Exception as e:  # detection numbers still valid
+                sim_out["error"] = repr(e)
+
+        th = threading.Thread(target=run_det, daemon=True)
+        th.start()
+        status_dir = pathlib.Path(td) / "health24" / "status"
+        # stall_s effectively off: the latency metric is defined
+        # against the OBSERVABLE cohort lag (which the raw poll below
+        # mirrors exactly); the wall-clock no-advance path would fire
+        # on its own schedule and make the anchor unattributable
+        engine = HealthEngine(config=HealthConfig(
+            liveness_s=LIVENESS_S, stall_rounds=2, stall_s=3600.0))
+        crashed_last_seen = None
+        stall_onset = None
+        detect_dead = detect_stall = None
+        deadline = time.monotonic() + 150
+        while time.monotonic() < deadline:
+            now = time.time()
+            recs = {r.get("node"): r for r in read_statuses(status_dir)}
+            crec = recs.get(CRASHED)
+            if crec is not None:
+                # last publish ts BEFORE silence: keeps updating while
+                # alive, freezes at the crash
+                crashed_last_seen = float(crec.get("ts", now))
+            rounds = {n: int(r["round"]) for n, r in recs.items()
+                      if r.get("round") is not None
+                      and now - float(r.get("ts", 0)) <= LIVENESS_S}
+            if (stall_onset is None and STRAGGLER in rounds
+                    and max(rounds.values())
+                    - rounds[STRAGGLER] >= 2):
+                stall_onset = now  # lag observable in raw telemetry
+            evaluate_dir(status_dir, engine=engine, now=now)
+            for tr in engine.transitions:
+                if tr["event"] != "fire":
+                    continue
+                if (detect_dead is None and tr["rule"] == "node-dead"
+                        and tr["node"] == CRASHED
+                        and crashed_last_seen is not None):
+                    detect_dead = tr["ts"] - crashed_last_seen
+                if (detect_stall is None and tr["rule"] == "round-stall"
+                        and tr["node"] == STRAGGLER
+                        and stall_onset is not None):
+                    # the engine re-reads the dir after the raw poll's
+                    # snapshot, so it can see a fresher front record by
+                    # a few ms — clamp, never report a negative latency
+                    detect_stall = max(tr["ts"] - stall_onset, 0.0)
+            if detect_dead is not None and detect_stall is not None:
+                break
+            if not th.is_alive():
+                # sim over: everything ages out within one liveness
+                # window — anything not detected by then never will be
+                deadline = min(deadline,
+                               time.monotonic() + LIVENESS_S + 1.0)
+            time.sleep(0.1)
+        th.join(timeout=30)
+        fired = {(t["rule"], t["node"]) for t in engine.transitions
+                 if t["event"] == "fire"}
+        dumps = sorted(pathlib.Path(td).rglob("flight_*.json"))
+        part.update({
+            "obs_health_detect_dead_s":
+                round(detect_dead, 3) if detect_dead is not None
+                else None,
+            "obs_health_detect_stall_s":
+                round(detect_stall, 3) if detect_stall is not None
+                else None,
+            "obs_health_rules_fired": len(fired),
+            "obs_health_flight_dump_bytes":
+                sum(p.stat().st_size for p in dumps) if dumps else None,
+        })
+        _part(part)  # stream: a mid-phase kill keeps the latencies
+
+    # ---- (b) always-on overhead, interleaved A/B ---------------------
+    def cfg8(log_dir) -> ScenarioConfig:
+        return ScenarioConfig(
+            name="health8", n_nodes=8, topology="fully",
+            data=DataConfig(dataset="mnist", samples_per_node=60),
+            training=TrainingConfig(rounds=3, epochs_per_round=1,
+                                    learning_rate=0.05),
+            protocol=ProtocolConfig(heartbeat_period_s=0.5,
+                                    aggregation_timeout_s=60.0,
+                                    vote_timeout_s=10.0,
+                                    train_set_size=8),
+            log_dir=log_dir,
+        )
+
+    def sim_on() -> dict:
+        flight.configure(enabled=True)
+        with tempfile.TemporaryDirectory() as td2:
+            stop = threading.Event()
+            eng = HealthEngine()
+            scen_dir = pathlib.Path(td2) / "health8"
+
+            def watcher() -> None:
+                while not stop.is_set():
+                    evaluate_dir(scen_dir, engine=eng)
+                    stop.wait(0.5)
+
+            wt = threading.Thread(target=watcher, daemon=True)
+            wt.start()
+            try:
+                return run_simulation(cfg8(td2), timeout=240)
+            finally:
+                stop.set()
+                wt.join(timeout=5)
+
+    def sim_off() -> dict:
+        flight.configure(enabled=False)
+        try:
+            return run_simulation(cfg8(None), timeout=240)
+        finally:
+            flight.configure(enabled=True)
+
+    def on_run(tag, i, r):
+        if tag == "b" and i == 0:
+            _part({"obs_health_round_s_off": r.get("round_s")})
+
+    best_on, best_off = _ab_interleaved(sim_on, sim_off, on_run=on_run)
+    part = {"obs_health_round_s_on":
+                best_on["round_s"] if best_on else None,
+            "obs_health_round_s_off":
+                best_off["round_s"] if best_off else None}
+    if best_on and best_off:
+        part["obs_health_overhead_pct"] = round(
+            100.0 * (best_on["round_s"] - best_off["round_s"])
+            / best_off["round_s"], 2)
+    _part(part)
+
+
 def _phase_comm() -> None:
     """Communication A/Bs (round 10: hide the wire under the fit),
     both planes, each interleaved min-of-2 via ``_ab_interleaved``:
@@ -1686,6 +1920,31 @@ print("BENCH_ELASTIC " + json.dumps({"sync": sync, "async": asy}),
               flush=True)
 
 
+def _run_meta() -> dict:
+    """Provenance stamp for every BENCH json — what
+    scripts/check_bench_regress.py prints next to its verdict, so a
+    trajectory entry is traceable to the code + toolchain that
+    produced it. Never raises: an unstampable field is just absent."""
+    import socket
+
+    meta: dict = {"seed": 0, "host": socket.gethostname(),
+                  "ts": round(time.time(), 1)}
+    try:
+        meta["git_sha"] = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"], cwd=_REPO,
+            capture_output=True, text=True, timeout=10,
+        ).stdout.strip() or None
+    except (OSError, subprocess.SubprocessError):
+        pass
+    try:
+        from importlib.metadata import version
+
+        meta["jax"] = version("jax")
+    except Exception:
+        pass
+    return meta
+
+
 def _phase_selftest() -> None:
     """Test hook (tests/test_bench_orchestration.py): emit one part,
     then crash — exercises the parent's guarantee that parts from a
@@ -1793,6 +2052,7 @@ def main() -> None:
                          "(BASELINE.md)",
         "synthetic_data": None,
         "skipped_phases": [],
+        "meta": _run_meta(),
     }
     emitted = False
 
@@ -1827,6 +2087,7 @@ def main() -> None:
         ("comm", "_phase_comm", 150),
         ("socket_mp", "_phase_socket_mp", 150),
         ("obs", "_phase_obs", 90),
+        ("obs_health", "_phase_obs_health", 120),
         ("robust", "_phase_robust", 150),
         ("elastic", "_phase_elastic", 150),
         ("vit32", "_phase_vit32", 120),

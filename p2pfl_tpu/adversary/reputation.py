@@ -39,6 +39,8 @@ from __future__ import annotations
 
 import numpy as np
 
+from p2pfl_tpu.obs import flight
+
 try:  # jnp is optional at import time: the monitor itself is numpy-only
     import jax.numpy as jnp
 except Exception:  # pragma: no cover - jax is a hard dep of the repo
@@ -160,10 +162,19 @@ class ReputationMonitor:
             else np.asarray(mask, bool)
         )
         a = self.alpha
+        before = set(self.suspects())
         blended = np.where(self._seen, (1.0 - a) * self.trust + a * scores,
                            scores)
         self.trust = np.where(obs, blended, self.trust).astype(np.float32)
         self._seen = self._seen | obs
+        after = set(self.suspects())
+        for node in sorted(after - before):
+            flight.record("reputation.exclude", node=node,
+                          trust=float(self.trust[node]),
+                          cutoff=self.cutoff)
+        for node in sorted(before - after):
+            flight.record("reputation.restore", node=node,
+                          trust=float(self.trust[node]))
         self.history.append([float(t) for t in self.trust])
 
     def observe_entries(self, reference, entries) -> None:

@@ -18,6 +18,7 @@ import jax.numpy as jnp
 import numpy as np
 from flax import serialization as flax_ser
 
+from p2pfl_tpu.obs import flight
 from p2pfl_tpu.parallel.federated import FederatedState
 
 _SUFFIX = ".ckpt.msgpack"
@@ -98,6 +99,8 @@ def save_checkpoint(directory: str | pathlib.Path, fed: FederatedState) -> pathl
         from jax.experimental import multihost_utils
 
         multihost_utils.sync_global_devices(f"p2pfl-ckpt-{int(host.round)}")
+    flight.record("checkpoint.save", round=int(host.round),
+                  path=str(path))
     return path
 
 
@@ -118,6 +121,7 @@ def load_checkpoint(path: str | pathlib.Path, template: FederatedState) -> Feder
     """Restore into the structure of ``template`` (shape/dtype checked
     by flax's from_bytes-style restore against the template leaves)."""
     obj = flax_ser.msgpack_restore(pathlib.Path(path).read_bytes())
+    flight.record("checkpoint.load", path=str(path))
     try:
         restored = flax_ser.from_state_dict(template, obj)
     except Exception as e:

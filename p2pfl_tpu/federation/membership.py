@@ -13,6 +13,7 @@ import numpy as np
 
 from p2pfl_tpu.config.schema import FaultEvent, ProtocolConfig
 from p2pfl_tpu.federation.events import Events, Observable
+from p2pfl_tpu.obs import flight
 
 
 class Membership(Observable):
@@ -70,6 +71,7 @@ class Membership(Observable):
         self.next_probe[node] = np.inf
         if not self.alive[node]:
             self.alive[node] = True
+            flight.record("membership.recover", node=node, t=t)
             self.notify(Events.NODE_RECOVERED, {"node": node, "t": t})
 
     def apply_fault(self, fault: FaultEvent) -> None:
@@ -82,6 +84,8 @@ class Membership(Observable):
             self.beating[fault.node] = True
             self.beat(fault.node)
             if fault.kind == "join":
+                flight.record("membership.join", node=fault.node,
+                              t=self.clock)
                 self.notify(Events.NODE_JOINED,
                             {"node": fault.node, "t": self.clock})
         else:
@@ -108,6 +112,8 @@ class Membership(Observable):
         t = self.clock if t is None else t
         self.probe_failures[node] += 1
         k = int(self.probe_failures[node])
+        flight.record("membership.probe_failed", node=node, k=k,
+                      final=k >= self.retry_limit)
         if k >= self.retry_limit:
             return True
         delay = min(self.backoff_base_s * (2.0 ** k), self.backoff_max_s)
@@ -136,6 +142,7 @@ class Membership(Observable):
                 # one backoff base from the detected timeout
                 self.probe_failures[node] = 0
                 self.next_probe[node] = t + self.backoff_base_s
+                flight.record("membership.suspect", node=node, t=t)
                 self.notify(Events.NODE_DIED, {"node": node, "t": t})
         return self.alive.copy()
 
@@ -146,6 +153,7 @@ class Membership(Observable):
         self.departed[node] = True
         self.beating[node] = False
         self.next_probe[node] = np.inf  # no further reconnect probes
+        flight.record("membership.evict", node=node, t=self.clock)
         if self.alive[node]:
             self.alive[node] = False
             self.notify(Events.NODE_DIED, {"node": node, "t": self.clock})

@@ -56,6 +56,7 @@ from p2pfl_tpu.parallel.federated import (
     staleness_scale,
     with_staged_buffer,
 )
+from p2pfl_tpu.obs import flight
 from p2pfl_tpu.obs import trace as obs_trace
 from p2pfl_tpu.parallel.transport import MeshTransport, edge_offsets
 from p2pfl_tpu.topology.topology import generate_topology
@@ -520,6 +521,7 @@ class Scenario(Observable):
                         round(float(self.reputation.trust[i]), 4)
                         if self.reputation is not None else None
                     ),
+                    "recompiles": obs_trace.xla_recompiles(),
                 },
             )
 
@@ -549,6 +551,8 @@ class Scenario(Observable):
             default_dir=(self.logger.dir / "trace")
             if self.logger.dir else None,
         )
+        if self.logger.dir is not None:
+            flight.configure(dump_dir=self.logger.dir / "flight")
         round_times: list[float] = []
         self.round_times_s = round_times  # _publish_statuses reads p95
         rounds_to_target = None

@@ -1,0 +1,382 @@
+"""Declarative health rules over the federation's passive telemetry.
+
+The monitor (utils.monitor) and the webapp *render* the status records
+every node publishes; nothing in the stack *judges* them — a stalled
+round, a silently evicted node, or a trust collapse is only visible to
+a human staring at the table. This module is the judging half: a small
+rule engine evaluated over the same two streams the dashboards already
+tail (``node_<i>.status.json`` records and the ``metrics.jsonl``
+event stream), with **firing/clear semantics** — an alert is a stateful
+object that fires once when its condition appears, updates while it
+holds, and clears when it goes away, so a watcher (the monitor's
+alerts pane, the healthcheck CLI's exit code, the bench's detection-
+latency probe) sees transitions, not a re-printed condition.
+
+Built-in rules (severity in parentheses; all thresholds live on
+``HealthConfig``):
+
+- ``round-stall`` (warn): a live node's round lags the cohort's max
+  round by ``stall_rounds``+, or — with engine state across
+  evaluations — a live node's round hasn't advanced in ``stall_s``.
+- ``node-dead`` (warn → crit): a node's status record is older than
+  ``liveness_s``. Escalates to crit — dead *beyond quorum* — when the
+  remaining live cohort falls below ``quorum_frac`` of the published
+  federation, with an extra federation-level finding.
+- ``trust-collapse`` (crit): a published trust score fell below
+  ``trust_floor`` (reputation-weighted runs only).
+- ``byte-rate`` (warn): a node's cumulative wire traffic exceeds
+  ``byte_ratio`` x the cohort median by at least ``byte_floor`` bytes
+  — the signature of a relay storm or a gossip loop.
+- ``recompile-storm`` (warn): a node reports more than
+  ``recompile_storm`` post-warm-up XLA backend compiles (the round-7
+  storm, perf.md §7b, as a live alert instead of a bench postmortem).
+- ``accuracy-divergence`` (warn): a node's accuracy sits
+  ``divergence`` below the cohort median (statuses first, newest
+  ``metrics.jsonl`` Test/accuracy rows as fallback).
+
+The engine is deliberately read-only and dependency-light: it never
+talks to nodes, only to the filesystem artifacts they already publish,
+so it runs identically against a live run, a finished run's corpse, or
+a synthetic directory in a test.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import pathlib
+import time
+from typing import Any, Callable
+
+from p2pfl_tpu.obs import flight
+from p2pfl_tpu.utils.monitor import DEFAULT_LIVENESS_S, read_statuses
+
+SEVERITY_ORDER = ("ok", "warn", "crit")
+
+
+def worse(a: str, b: str) -> str:
+    return a if SEVERITY_ORDER.index(a) >= SEVERITY_ORDER.index(b) else b
+
+
+@dataclasses.dataclass(frozen=True)
+class Alert:
+    """One firing rule instance. ``node`` None = federation-level."""
+
+    rule: str
+    severity: str
+    node: int | None
+    message: str
+    since: float
+
+    def to_dict(self) -> dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
+class HealthConfig:
+    """Thresholds for the built-in rules (see module doc)."""
+
+    liveness_s: float = DEFAULT_LIVENESS_S
+    stall_rounds: int = 2
+    stall_s: float = 30.0
+    quorum_frac: float = 0.5
+    trust_floor: float = 0.15
+    byte_ratio: float = 8.0
+    byte_floor: float = 1e6
+    recompile_storm: int = 32
+    divergence: float = 0.15
+    min_cohort: int = 3  # cohort-relative rules need a real median
+
+
+@dataclasses.dataclass
+class Snapshot:
+    """One evaluation's inputs: the status records, a metrics tail,
+    and the clock they are judged against."""
+
+    statuses: list[dict[str, Any]]
+    metrics: list[dict[str, Any]]
+    now: float
+    cfg: HealthConfig
+
+    def age(self, rec: dict[str, Any]) -> float:
+        return max(self.now - float(rec.get("ts", 0.0)), 0.0)
+
+    def alive(self) -> list[dict[str, Any]]:
+        return [r for r in self.statuses
+                if self.age(r) <= self.cfg.liveness_s]
+
+    def node_accuracy(self) -> dict[int, float]:
+        """Latest accuracy per node: status field first, newest
+        Test/accuracy metrics row as fallback."""
+        out: dict[int, float] = {}
+        for rec in self.metrics:  # oldest→newest; later rows win
+            if rec.get("node") is not None and "Test/accuracy" in rec:
+                out[int(rec["node"])] = float(rec["Test/accuracy"])
+        for rec in self.statuses:
+            if rec.get("accuracy") is not None:
+                out[int(rec.get("node", -1))] = float(rec["accuracy"])
+        return out
+
+
+# ---------------------------------------------------------------------
+# built-in rules: (Snapshot, HealthEngine) -> [finding dict]
+# a finding is {"node": int|None, "message": str, "severity"?: str}
+# ---------------------------------------------------------------------
+
+def rule_round_stall(snap: Snapshot, eng: "HealthEngine") -> list[dict]:
+    out = []
+    alive = [r for r in snap.alive() if r.get("round") is not None]
+    rounds = [int(r["round"]) for r in alive]
+    front = max(rounds) if rounds else 0
+    for rec in alive:
+        node, rnd = int(rec.get("node", -1)), int(rec["round"])
+        lag = front - rnd
+        seen = eng.round_progress.get(node)
+        stuck_s = (snap.now - seen[1]) if seen and seen[0] == rnd else 0.0
+        if len(alive) >= 2 and lag >= snap.cfg.stall_rounds:
+            out.append({"node": node,
+                        "message": f"round {rnd} lags cohort front "
+                                   f"{front} by {lag}"})
+        elif stuck_s > snap.cfg.stall_s:
+            out.append({"node": node,
+                        "message": f"round {rnd} unchanged for "
+                                   f"{stuck_s:.0f}s"})
+    return out
+
+
+def rule_node_dead(snap: Snapshot, eng: "HealthEngine") -> list[dict]:
+    dead = [r for r in snap.statuses
+            if snap.age(r) > snap.cfg.liveness_s]
+    if not dead:
+        return []
+    n = len(snap.statuses)
+    n_alive = n - len(dead)
+    quorum = max(1, int(snap.cfg.quorum_frac * n + 0.9999))
+    broken = n_alive < quorum
+    sev = "crit" if broken else "warn"
+    out = [
+        {"node": int(r.get("node", -1)), "severity": sev,
+         "message": f"silent for {snap.age(r):.0f}s "
+                    f"(liveness {snap.cfg.liveness_s:.0f}s)"}
+        for r in dead
+    ]
+    if broken:
+        out.append({"node": None, "severity": "crit",
+                    "message": f"quorum lost: {n_alive}/{n} alive "
+                               f"(need {quorum})"})
+    return out
+
+
+def rule_trust_collapse(snap: Snapshot, eng: "HealthEngine") -> list[dict]:
+    return [
+        {"node": int(r.get("node", -1)),
+         "message": f"trust {float(r['trust']):.3f} < floor "
+                    f"{snap.cfg.trust_floor}"}
+        for r in snap.alive()
+        if r.get("trust") is not None
+        and float(r["trust"]) < snap.cfg.trust_floor
+    ]
+
+
+def rule_byte_rate(snap: Snapshot, eng: "HealthEngine") -> list[dict]:
+    recs = [r for r in snap.alive() if r.get("bytes_out") is not None]
+    if len(recs) < snap.cfg.min_cohort:
+        return []
+    vals = sorted(float(r["bytes_out"]) for r in recs)
+    med = vals[len(vals) // 2]
+    out = []
+    for r in recs:
+        b = float(r["bytes_out"])
+        if b > med * snap.cfg.byte_ratio and b - med > snap.cfg.byte_floor:
+            out.append({"node": int(r.get("node", -1)),
+                        "message": f"bytes_out {b / 1e6:.1f}MB vs cohort "
+                                   f"median {med / 1e6:.1f}MB"})
+    return out
+
+
+def rule_recompile_storm(snap: Snapshot, eng: "HealthEngine") -> list[dict]:
+    return [
+        {"node": int(r.get("node", -1)),
+         "message": f"{int(r['recompiles'])} post-warm-up XLA compiles "
+                    f"(> {snap.cfg.recompile_storm})"}
+        for r in snap.alive()
+        if r.get("recompiles") is not None
+        and int(r["recompiles"]) > snap.cfg.recompile_storm
+    ]
+
+
+def rule_accuracy_divergence(snap: Snapshot,
+                             eng: "HealthEngine") -> list[dict]:
+    acc = snap.node_accuracy()
+    if len(acc) < snap.cfg.min_cohort:
+        return []
+    vals = sorted(acc.values())
+    med = vals[len(vals) // 2]
+    return [
+        {"node": node,
+         "message": f"accuracy {a:.4f} is {med - a:.4f} below cohort "
+                    f"median {med:.4f}"}
+        for node, a in sorted(acc.items())
+        if med - a > snap.cfg.divergence
+    ]
+
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    name: str
+    severity: str  # default severity; a finding may override
+    check: Callable[[Snapshot, "HealthEngine"], list[dict]]
+
+
+def default_rules() -> list[Rule]:
+    return [
+        Rule("round-stall", "warn", rule_round_stall),
+        Rule("node-dead", "warn", rule_node_dead),
+        Rule("trust-collapse", "crit", rule_trust_collapse),
+        Rule("byte-rate", "warn", rule_byte_rate),
+        Rule("recompile-storm", "warn", rule_recompile_storm),
+        Rule("accuracy-divergence", "warn", rule_accuracy_divergence),
+    ]
+
+
+class HealthEngine:
+    """Stateful evaluator: tracks which (rule, node) pairs are firing,
+    records fire/clear transitions (also into the flight recorder —
+    alerts are themselves control events worth a postmortem), and
+    remembers per-node round progress so the stall rule can see time,
+    not just a single snapshot."""
+
+    def __init__(self, rules: list[Rule] | None = None,
+                 config: HealthConfig | None = None):
+        self.rules = list(rules) if rules is not None else default_rules()
+        self.config = config or HealthConfig()
+        self.active: dict[tuple[str, int | None], Alert] = {}
+        self.transitions: list[dict[str, Any]] = []
+        # node -> (round, ts first seen at that round)
+        self.round_progress: dict[int, tuple[int, float]] = {}
+
+    # -- evaluation -----------------------------------------------------
+    def _note_progress(self, snap: Snapshot) -> None:
+        for rec in snap.statuses:
+            if rec.get("round") is None:
+                continue
+            node, rnd = int(rec.get("node", -1)), int(rec["round"])
+            seen = self.round_progress.get(node)
+            if seen is None or seen[0] != rnd:
+                self.round_progress[node] = (rnd, snap.now)
+
+    def evaluate(self, statuses: list[dict[str, Any]],
+                 metrics: list[dict[str, Any]] | None = None,
+                 now: float | None = None) -> list[Alert]:
+        now = time.time() if now is None else now
+        snap = Snapshot(statuses, list(metrics or ()), now, self.config)
+        found: dict[tuple[str, int | None], tuple[str, str]] = {}
+        for rule in self.rules:
+            for f in rule.check(snap, self):
+                key = (rule.name, f.get("node"))
+                found[key] = (f.get("severity", rule.severity),
+                              f["message"])
+        # progress bookkeeping AFTER the rules: a round advance must be
+        # judged against the PREVIOUS evaluation's state, or a stalled
+        # node would reset its own stall clock every tick
+        self._note_progress(snap)
+        for key, (sev, msg) in found.items():
+            cur = self.active.get(key)
+            if cur is None:
+                self.active[key] = Alert(key[0], sev, key[1], msg, now)
+                self.transitions.append(
+                    {"event": "fire", "rule": key[0], "node": key[1],
+                     "severity": sev, "message": msg, "ts": now})
+                flight.record("health.fire", rule=key[0], node=key[1],
+                              severity=sev, message=msg)
+            else:  # still firing: refresh message/severity, keep since
+                self.active[key] = dataclasses.replace(
+                    cur, severity=sev, message=msg)
+        for key in [k for k in self.active if k not in found]:
+            gone = self.active.pop(key)
+            self.transitions.append(
+                {"event": "clear", "rule": gone.rule, "node": gone.node,
+                 "severity": gone.severity, "ts": now})
+            flight.record("health.clear", rule=gone.rule, node=gone.node)
+        return self.alerts()
+
+    # -- reading --------------------------------------------------------
+    def alerts(self) -> list[Alert]:
+        """Active alerts, most severe first, then by rule/node."""
+        return sorted(
+            self.active.values(),
+            key=lambda a: (-SEVERITY_ORDER.index(a.severity), a.rule,
+                           -1 if a.node is None else a.node),
+        )
+
+    def worst(self) -> str:
+        sev = "ok"
+        for a in self.active.values():
+            sev = worse(sev, a.severity)
+        return sev
+
+
+# ---------------------------------------------------------------------
+# filesystem plumbing: evaluate a scenario directory
+# ---------------------------------------------------------------------
+
+def tail_jsonl(path: str | pathlib.Path, max_bytes: int = 256 * 1024
+               ) -> list[dict[str, Any]]:
+    """Tolerant JSONL tail: O(window) read, first line dropped when the
+    window is clipped mid-line, and any torn row (a writer's partial
+    trailing line observed live) skipped instead of raised."""
+    path = pathlib.Path(path)
+    try:
+        with open(path, "rb") as f:
+            f.seek(0, 2)
+            size = f.tell()
+            f.seek(max(0, size - max_bytes))
+            data = f.read()
+    except OSError:
+        return []
+    text = data.decode("utf-8", errors="replace")
+    lines = text.splitlines()
+    if size > max_bytes and lines:
+        lines = lines[1:]
+    out = []
+    for line in lines:
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue  # torn or foreign row — skip, never raise
+        if isinstance(rec, dict):
+            out.append(rec)
+    return out
+
+
+def resolve_dirs(directory: str | pathlib.Path
+                 ) -> tuple[pathlib.Path, list[pathlib.Path]]:
+    """(status dir, metrics.jsonl candidates) for a target that may be
+    the status dir itself or the scenario dir containing it."""
+    d = pathlib.Path(directory)
+    status = d / "status" if (d / "status").is_dir() else d
+    metrics = [
+        p for p in (status / "metrics.jsonl",
+                    status.parent / "metrics.jsonl",
+                    d / "metrics.jsonl")
+        if p.is_file()
+    ]
+    seen: set[pathlib.Path] = set()
+    uniq = [p for p in metrics
+            if p.resolve() not in seen and not seen.add(p.resolve())]
+    return status, uniq
+
+
+def evaluate_dir(directory: str | pathlib.Path,
+                 engine: HealthEngine | None = None,
+                 now: float | None = None) -> tuple[list[Alert], HealthEngine]:
+    """One evaluation over a scenario/status directory. Pass the same
+    engine across calls to get firing/clear transitions and the
+    stateful stall clock; a fresh engine gives a one-shot view."""
+    engine = engine or HealthEngine()
+    status_dir, metric_files = resolve_dirs(directory)
+    metrics: list[dict[str, Any]] = []
+    for p in metric_files:
+        metrics.extend(tail_jsonl(p))
+    alerts = engine.evaluate(read_statuses(status_dir), metrics, now=now)
+    return alerts, engine

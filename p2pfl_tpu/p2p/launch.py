@@ -32,6 +32,7 @@ from p2pfl_tpu.core.aggregators import get_aggregator
 from p2pfl_tpu.datasets import FederatedDataset
 from p2pfl_tpu.learning import JaxLearner
 from p2pfl_tpu.models.base import build_model
+from p2pfl_tpu.obs import flight
 from p2pfl_tpu.obs import trace as obs_trace
 from p2pfl_tpu.p2p.node import P2PNode
 from p2pfl_tpu.topology.topology import generate_topology
@@ -43,6 +44,12 @@ def _trace_setup(cfg: ScenarioConfig) -> obs_trace.Tracer:
     — the same directory convention as the status dir, so traceview
     finds every process of a federation under one root."""
     obs_trace.install_xla_listener()
+    if cfg.log_dir:
+        # flight postmortems land next to the status/trace dirs; the
+        # recorder itself is always on (P2PFL_FLIGHT=0 to disable)
+        flight.configure(
+            dump_dir=pathlib.Path(cfg.log_dir) / cfg.name / "flight"
+        )
     return obs_trace.configure_from_env(
         default_dir=(pathlib.Path(cfg.log_dir) / cfg.name / "trace")
         if cfg.log_dir else None,
@@ -220,7 +227,8 @@ async def _run_node(cfg: ScenarioConfig, idx: int, ports: list[int],
                      "leader": node.leader,
                      "round_p95_s": node.round_p95_s(),
                      "bytes_in": node.bytes_in,
-                     "bytes_out": node.bytes_out},
+                     "bytes_out": node.bytes_out,
+                     "recompiles": obs_trace.xla_recompiles()},
                 )
                 await asyncio.sleep(cfg.protocol.heartbeat_period_s)
 
@@ -294,7 +302,15 @@ def node_main(config_path: str, idx: int | list[int], ports: list[int],
             )
         )
 
-    results = asyncio.run(_run_all())
+    try:
+        results = asyncio.run(_run_all())
+    except Exception as e:
+        # an unhandled child-process exception is exactly the moment
+        # the control-event ring matters: dump before dying so the
+        # parent finds a postmortem next to the (absent) result line
+        flight.record("proc.exception", nodes=idxs, error=repr(e))
+        flight.dump(f"proc{idxs[0]}.exception")
+        raise
     if tracer.enabled:
         # one file per OS process; nodes sharing this event loop are
         # separated by lane inside it (traceview merges across files)
@@ -409,6 +425,37 @@ async def _simulate(cfg: ScenarioConfig, timeout: float = 600) -> dict:
                 continue
         joined.append(i)
 
+    status_task = None
+    if cfg.log_dir:
+        # simulation-mode status publishing (round 12): the same
+        # records _run_node's per-process loop publishes, emitted for
+        # every node from one task — so the monitor/healthcheck see an
+        # in-process federation too. A crashed/finished node is
+        # SKIPPED, not final-published: its record ages out exactly
+        # like a killed process's would, which is what the node-dead
+        # rule keys on.
+        from p2pfl_tpu.utils.monitor import publish_status
+
+        status_dir = pathlib.Path(cfg.log_dir) / cfg.name / "status"
+
+        async def _status_loop() -> None:
+            while True:
+                for nd in nodes:
+                    if nd.finished.is_set():
+                        continue
+                    publish_status(
+                        status_dir, nd.idx,
+                        {"role": nd.role, "round": nd.round,
+                         "peers": len(nd.peers), "leader": nd.leader,
+                         "round_p95_s": nd.round_p95_s(),
+                         "bytes_in": nd.bytes_in,
+                         "bytes_out": nd.bytes_out,
+                         "recompiles": obs_trace.xla_recompiles()},
+                    )
+                await asyncio.sleep(cfg.protocol.heartbeat_period_s)
+
+        status_task = asyncio.create_task(_status_loop())
+
     fault_task = None
     if cfg.faults:
         events = sorted(cfg.faults, key=lambda f: (f.round, f.node))
@@ -448,6 +495,10 @@ async def _simulate(cfg: ScenarioConfig, timeout: float = 600) -> dict:
             fault_task.cancel()
             with contextlib.suppress(asyncio.CancelledError):
                 await fault_task
+        if status_task is not None:
+            status_task.cancel()
+            with contextlib.suppress(asyncio.CancelledError):
+                await status_task
         for node in nodes:
             await node.stop()
     accs = [

@@ -48,6 +48,7 @@ from p2pfl_tpu.core.serialize import (
     quantize_int8,
 )
 from p2pfl_tpu.federation.membership import Membership
+from p2pfl_tpu.obs import flight
 from p2pfl_tpu.obs.trace import get_tracer
 from p2pfl_tpu.p2p.protocol import (
     GOSSIPED,
@@ -319,6 +320,7 @@ class P2PNode:
         if self._crashed:
             return
         self._crashed = True
+        flight.record("node.crash", node=self.idx, round=self.round)
         self.learning = False
         for t in [self._learn_task, *self._tasks]:
             if t is not None:
@@ -341,6 +343,9 @@ class P2PNode:
         if self._server:
             self._server.close()
         self.finished.set()
+        # postmortem: the crash is exactly the moment the ring's
+        # churn history stops being reconstructible any other way
+        flight.dump(f"node{self.idx}.crash")
 
     async def stop(self) -> None:
         if self._crashed:
@@ -510,6 +515,8 @@ class P2PNode:
         with self._tracer.span("p2p.state_sync", lane=self._lane,
                                args={"peer": peer.idx,
                                      "round": self.round}):
+            flight.record("checkpoint.state_sync_out", node=self.idx,
+                          peer=peer.idx, round=self.round)
             blob = pack_model(self.learner.get_parameters(), self.round)
             msg = self._sign(
                 Message(MsgType.STATE_SYNC, self.idx,
@@ -532,6 +539,11 @@ class P2PNode:
         self._peer_wire[int(hello.sender)] = tuple(
             str(d) for d in hello.body.get("wd", ())
         )
+        # once per CONNECT hello (NOT per send — _wire_dtype_for is hot)
+        flight.record("wire.negotiate", node=self.idx,
+                      peer=int(hello.sender),
+                      peer_wd=list(self._peer_wire[int(hello.sender)]),
+                      own=str(self.wire_dtype))
 
     def _register_peer(self, idx: int, reader, writer) -> PeerState:
         peer = PeerState(idx=idx, writer=writer)
@@ -639,6 +651,7 @@ class P2PNode:
         conn = self.peers.pop(node, None)
         if conn is not None:
             self._teardown_conn(conn)
+        flight.dump(f"node{self.idx}.evicted_peer{node}")
 
     async def _drain_send_q(self, peer: PeerState) -> None:
         """Backpressure writer for one connection: drains the peer's
@@ -902,6 +915,8 @@ class P2PNode:
         if not self.joiner:
             return
         rnd = int(msg.body.get("round", 0))
+        flight.record("checkpoint.state_sync_in", node=self.idx,
+                      peer=int(msg.sender), round=rnd)
         with self._tracer.span("p2p.join", lane=self._lane,
                                args={"round": rnd, "from": msg.sender}):
             if rnd > self.round:
@@ -1232,6 +1247,7 @@ class P2PNode:
                     except Exception:
                         ok = False
             if ok:
+                flight.record("membership.probe", node=node, ok=True)
                 if self._tracer.enabled:
                     self._tracer.count("probe_ok")
             elif self.membership.probe_failed(node):
@@ -1511,6 +1527,8 @@ class P2PNode:
         (seed, idx, round) so the SPMD row is bit-identical."""
         from p2pfl_tpu.adversary.attacks import poison_update
 
+        flight.record("attack.inject", node=self.idx, round=self.round,
+                      attack=type(self.attack).__name__)
         self.learner.set_parameters(
             poison_update(self.learner.get_parameters(), ref,
                           self.idx, self.round, self.attack)

@@ -47,6 +47,7 @@ import numpy as np
 
 from p2pfl_tpu.core.aggregators import Aggregator, FedAvg
 from p2pfl_tpu.core.pytree import tree_stack
+from p2pfl_tpu.obs import flight
 from p2pfl_tpu.obs.trace import get_tracer
 from p2pfl_tpu.parallel.federated import staleness_scale
 
@@ -97,6 +98,9 @@ class AggregationSession:
     def set_nodes_to_aggregate(self, train_set) -> None:
         self.train_set = frozenset(int(i) for i in train_set)
         self._deadline = time.monotonic() + self.timeout_s
+        flight.record("session.open", lane=self._lane,
+                      train_set=sorted(self.train_set),
+                      quorum=self.quorum())
 
     def set_waiting_aggregated_model(self) -> None:
         """TRAINER/PROXY/IDLE: adopt the next aggregate received."""
@@ -180,6 +184,10 @@ class AggregationSession:
             self.covered >= self.train_set
             or (self.async_mode and self.quorum_met())
         ):
+            if self.async_mode and not self.covered >= self.train_set:
+                flight.record("session.quorum", lane=self._lane,
+                              covered=sorted(self.covered),
+                              quorum=self.quorum())
             self._finish()
         return tuple(sorted(self.covered))
 
@@ -248,6 +256,9 @@ class AggregationSession:
             list(self.models.values()), keys=keys
         )
         self.result = (params, tuple(sorted(self.covered)))
+        flight.record("session.close", lane=self._lane,
+                      entries=len(keys), covered=sorted(self.covered),
+                      timed_out=self.timed_out())
         self.done.set()
 
     def _aggregate(self, entries,

@@ -55,6 +55,12 @@ class MetricsLogger:
         if self.enabled:
             self.dir = pathlib.Path(log_dir) / name
             self.dir.mkdir(parents=True, exist_ok=True)
+            # line-buffered AND written one complete line per write()
+            # call (log_metrics): in multi-process runs several
+            # appenders share this file, and POSIX O_APPEND only
+            # guarantees atomicity per write syscall — a row built from
+            # multiple write() calls could interleave with another
+            # process's row and tear both
             self._jsonl = open(self.dir / "metrics.jsonl", "a", buffering=1)
         else:
             self.dir = None
@@ -81,7 +87,12 @@ class MetricsLogger:
             )
         if not self.enabled:
             return
+        # single write() of one complete line + flush: live tailers
+        # (webapp tail_metrics, obs.health) may read mid-append, and a
+        # torn row must be at worst a *trailing* partial line they can
+        # skip — never an interleaved one
         self._jsonl.write(json.dumps(rec) + "\n")
+        self._jsonl.flush()
         if node is not None:
             self._node_csv(node, rec)
         if self._tensorboard:

@@ -74,10 +74,24 @@ def read_statuses(directory: str | pathlib.Path) -> list[dict[str, Any]]:
 
 
 _COLUMNS = ("node", "role", "round", "loss", "accuracy", "trust",
-            "peers", "p95s", "io_mb", "age")
+            "peers", "p95s", "io_mb", "age", "health")
 
 
-def _row(rec: dict[str, Any], now: float, liveness_s: float) -> dict[str, str]:
+def _health_cell(node: int | None, alerts) -> str:
+    """Worst active alert for one node as a short cell: ``ok``,
+    ``warn(rule)`` or ``crit(rule[+k])``. ``alerts`` is the active
+    list from obs.health (duck-typed: .node/.severity/.rule)."""
+    mine = [a for a in (alerts or ()) if a.node == node]
+    if not mine:
+        return "ok"
+    crit = [a for a in mine if a.severity == "crit"]
+    top = (crit or mine)[0]
+    extra = f"+{len(mine) - 1}" if len(mine) > 1 else ""
+    return f"{top.severity}({top.rule}{extra})"
+
+
+def _row(rec: dict[str, Any], now: float, liveness_s: float,
+         alerts=None) -> dict[str, str]:
     # clamp: cross-host clock skew can put a record's ts slightly in
     # this reader's future, and a rendered "-0.3s" age reads as a bug.
     # Liveness is unaffected (a negative age was always alive).
@@ -108,14 +122,46 @@ def _row(rec: dict[str, Any], now: float, liveness_s: float) -> dict[str, str]:
             else f"{(bi or 0) / 1e6:.1f}/{(bo or 0) / 1e6:.1f}"
         ),
         "age": f"{age:.1f}s" + ("" if alive else " DEAD"),
+        # round-12 health plane: worst active alert for this node
+        "health": _health_cell(rec.get("node"), alerts),
     }
 
 
+def render_alerts(alerts) -> str:
+    """Plain-text alerts pane: one line per active alert, most severe
+    first (the order obs.health.HealthEngine.alerts() returns)."""
+    if not alerts:
+        return "alerts: none"
+    lines = ["alerts:"]
+    for a in alerts:
+        who = "federation" if a.node is None else f"node {a.node}"
+        lines.append(f"  [{a.severity.upper():4s}] {a.rule} {who}: "
+                     f"{a.message}")
+    return "\n".join(lines)
+
+
+def render_alerts_html(alerts) -> str:
+    if not alerts:
+        return "<div class='alerts ok'>alerts: none</div>"
+    items = "".join(
+        "<li class='{cls}'>[{sev}] {rule} {who}: {msg}</li>".format(
+            cls=html.escape(a.severity),
+            sev=html.escape(a.severity.upper()),
+            rule=html.escape(a.rule),
+            who="federation" if a.node is None else f"node {a.node}",
+            msg=html.escape(a.message),
+        )
+        for a in alerts
+    )
+    return f"<div class='alerts'><ul>{items}</ul></div>"
+
+
 def render_table(statuses: list[dict[str, Any]], now: float | None = None,
-                 liveness_s: float = DEFAULT_LIVENESS_S) -> str:
+                 liveness_s: float = DEFAULT_LIVENESS_S,
+                 alerts=None) -> str:
     """Plain-text node table (the monitoring page's table, app.py:291+)."""
     now = time.time() if now is None else now
-    rows = [_row(r, now, liveness_s) for r in statuses]
+    rows = [_row(r, now, liveness_s, alerts=alerts) for r in statuses]
     widths = {
         c: max(len(c), *(len(r[c]) for r in rows)) if rows else len(c)
         for c in _COLUMNS
@@ -129,11 +175,12 @@ def render_table(statuses: list[dict[str, Any]], now: float | None = None,
 
 def render_table_html(statuses: list[dict[str, Any]],
                       now: float | None = None,
-                      liveness_s: float = DEFAULT_LIVENESS_S) -> str:
+                      liveness_s: float = DEFAULT_LIVENESS_S,
+                      alerts=None) -> str:
     """Just the node ``<table>`` — shared by the standalone dashboard
     page below and the webapp's scenario page."""
     now = time.time() if now is None else now
-    rows = [_row(r, now, liveness_s) for r in statuses]
+    rows = [_row(r, now, liveness_s, alerts=alerts) for r in statuses]
     body = "".join(
         "<tr class='{cls}'>{cells}</tr>".format(
             cls="dead" if "DEAD" in r["age"] else "alive",
@@ -147,11 +194,12 @@ def render_table_html(statuses: list[dict[str, Any]],
 
 def render_html(statuses: list[dict[str, Any]], now: float | None = None,
                 liveness_s: float = DEFAULT_LIVENESS_S,
-                refresh_s: int = 2) -> str:
+                refresh_s: int = 2, alerts=None) -> str:
     """Self-contained dashboard page (auto-refreshes via meta tag —
     re-render it in a loop with --watch for a live view)."""
     now = time.time() if now is None else now
-    table = render_table_html(statuses, now, liveness_s)
+    table = render_table_html(statuses, now, liveness_s, alerts=alerts)
+    pane = render_alerts_html(alerts)
     return f"""<!doctype html><html><head>
 <meta http-equiv="refresh" content="{refresh_s}">
 <title>p2pfl_tpu federation</title>
@@ -159,8 +207,11 @@ def render_html(statuses: list[dict[str, Any]], now: float | None = None,
 body{{font-family:monospace;background:#111;color:#ddd;padding:1em}}
 table{{border-collapse:collapse}} td,th{{padding:.3em .8em;border:1px solid #333}}
 tr.dead td{{color:#f55}} th{{background:#222}}
+.alerts{{margin:.6em 0}} .alerts li.crit{{color:#f55}}
+.alerts li.warn{{color:#fb0}} .alerts.ok{{color:#5a5}}
 </style></head><body>
 <h2>federation status — {time.strftime('%H:%M:%S', time.localtime(now))}</h2>
+{pane}
 {table}
 </body></html>"""
 
@@ -180,20 +231,30 @@ class StatusPublisher:
 def watch(directory: str | pathlib.Path, interval_s: float = 1.0,
           html_out: str | None = None, once: bool = False,
           liveness_s: float = DEFAULT_LIVENESS_S) -> None:
-    """Render the live table to the terminal (and optionally an HTML
-    dashboard file) until interrupted."""
+    """Render the live table + alerts pane to the terminal (and
+    optionally an HTML dashboard file) until interrupted. The health
+    engine is persistent across ticks, so the pane reflects firing/
+    clear transitions, not per-tick re-detections."""
+    # import here: obs.health imports read_statuses from this module
+    from p2pfl_tpu.obs.health import HealthConfig, HealthEngine, evaluate_dir
+
     directory = pathlib.Path(directory)
+    engine = HealthEngine(config=HealthConfig(liveness_s=liveness_s))
     while True:
         statuses = read_statuses(directory)
-        table = render_table(statuses, liveness_s=liveness_s)
+        alerts, _ = evaluate_dir(directory, engine=engine)
+        table = render_table(statuses, liveness_s=liveness_s,
+                             alerts=alerts)
+        pane = render_alerts(alerts)
         if html_out:
             out = pathlib.Path(html_out)
             tmp = out.with_suffix(out.suffix + ".tmp")
-            tmp.write_text(render_html(statuses, liveness_s=liveness_s))
+            tmp.write_text(render_html(statuses, liveness_s=liveness_s,
+                                       alerts=alerts))
             os.replace(tmp, out)
         if once:
-            print(table)
+            print(table + "\n" + pane)
             return
-        sys.stdout.write("\x1b[2J\x1b[H" + table + "\n")
+        sys.stdout.write("\x1b[2J\x1b[H" + table + "\n" + pane + "\n")
         sys.stdout.flush()
         time.sleep(interval_s)

@@ -82,9 +82,11 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, unquote
 
+from p2pfl_tpu.obs.health import HealthEngine, evaluate_dir
 from p2pfl_tpu.utils.monitor import (
     DEFAULT_LIVENESS_S,
     read_statuses,
+    render_alerts_html,
     render_table_html,
 )
 
@@ -92,6 +94,8 @@ _STYLE = """
 body{font-family:monospace;background:#111;color:#ddd;padding:1em}
 a{color:#7cf} table{border-collapse:collapse}
 td,th{padding:.3em .8em;border:1px solid #333} th{background:#222}
+.alerts{margin:.6em 0} .alerts li.crit{color:#f55}
+.alerts li.warn{color:#fb0} .alerts.ok{color:#5a5}
 pre{background:#000;padding:1em;overflow-x:auto}
 """
 
@@ -890,6 +894,18 @@ class DashboardHandler(BaseHTTPRequestHandler):
                 if self._safe_child(parts[2]) is None:
                     return self._json([])
                 return self._json(tail_metrics(self.root, parts[2]))
+            if len(parts) == 3 and parts[1] == "health":
+                safe = self._safe_child(parts[2])
+                if safe is None:
+                    return self._json({})
+                # one-shot engine: the HTTP surface is stateless, each
+                # GET re-judges the current snapshot (transition history
+                # lives in the healthcheck CLI / monitor watchers)
+                alerts, eng = evaluate_dir(safe, engine=HealthEngine())
+                return self._json({
+                    "severity": eng.worst(),
+                    "alerts": [a.to_dict() for a in alerts],
+                })
             if len(parts) == 3 and parts[1] == "topology3d":
                 path = self._safe_child(parts[2], "topology_3d.json")
                 if path is not None and path.is_file():
@@ -981,7 +997,10 @@ class DashboardHandler(BaseHTTPRequestHandler):
         if safe is None or not safe.is_dir():
             return self._send(_page("not found", "<p>404</p>"), code=404)
         statuses = read_statuses(safe / "status")
-        inner = render_table_html(statuses)
+        alerts, _ = evaluate_dir(safe, engine=HealthEngine())
+        inner = render_alerts_html(alerts) + render_table_html(
+            statuses, alerts=alerts
+        )
         logs = sorted((safe / "logs").glob("*.log")) if (
             safe / "logs").is_dir() else []
         links = " | ".join(

@@ -15,6 +15,10 @@ reference. This script fails (exit 1) when either side drifts:
    those names. Dynamic keys (f-strings, loop variables) are out of
    scope by design — they must still be registered by hand, which
    direction 1 then keeps documented.
+3. the regression gate's HEADLINE keys
+   (scripts/check_bench_regress.py) are not all registered in
+   ``BENCH_KEYS`` — the gate must never anchor on a key the bench
+   cannot emit (round 12).
 
 Run directly (``python scripts/check_bench_keys.py``) or via the
 tier-1 suite (tests/test_bench_orchestration.py).
@@ -106,6 +110,7 @@ def emitted_literal_keys(tree: ast.Module) -> set[str]:
 
 def main() -> int:
     sys.path.insert(0, str(REPO))
+    sys.path.insert(0, str(REPO / "scripts"))
     import bench
 
     registered = set(bench.BENCH_KEYS)
@@ -113,16 +118,24 @@ def main() -> int:
     tree = ast.parse((REPO / "bench.py").read_text())
     emitted = emitted_literal_keys(tree)
 
+    import check_bench_regress
+
     undocumented = sorted(k for k in registered if k not in doc)
     unregistered = sorted(emitted - registered)
+    ungated = sorted(set(check_bench_regress.HEADLINE) - registered)
     for k in undocumented:
         print(f"BENCH_KEYS entry not documented in docs/perf.md: {k!r}")
     for k in unregistered:
         print(f"bench.py emits a key missing from BENCH_KEYS: {k!r}")
-    if undocumented or unregistered:
+    for k in ungated:
+        print("check_bench_regress.HEADLINE key missing from "
+              f"BENCH_KEYS: {k!r}")
+    if undocumented or unregistered or ungated:
         return 1
     print(f"ok: {len(registered)} registered keys documented, "
-          f"{len(emitted)} literal emission keys all registered")
+          f"{len(emitted)} literal emission keys all registered, "
+          f"{len(check_bench_regress.HEADLINE)} regression-gate keys "
+          "registered")
     return 0
 
 

@@ -30,8 +30,12 @@ def test_zero_budget_still_emits_parseable_json():
     # everywhere), every phase is explicitly accounted as skipped
     assert set(out["skipped_phases"]) == {
         "headline", "cifar16", "cpu8", "socket24", "comm", "socket_mp",
-        "obs", "robust", "elastic", "vit32"
+        "obs", "obs_health", "robust", "elastic", "vit32"
     }
+    # the provenance stamp (round 12) rides the envelope even at zero
+    # budget — a regression report must always name its commit
+    meta = out["meta"]
+    assert set(meta) >= {"seed", "host", "ts", "git_sha", "jax"}
 
 
 def test_robust_phase_dry_run_emits_variant_plan():
@@ -133,6 +137,32 @@ def test_elastic_phase_dry_run_emits_key_plan():
     assert {"elastic_sync_wall_s", "elastic_async_wall_s",
             "elastic_async_speedup", "elastic_churn",
             "elastic_spmd_rounds_to_target_weighted"} <= planned
+    assert planned <= set(bench.BENCH_KEYS)
+
+
+def test_obs_health_phase_dry_run_emits_key_plan():
+    """P2PFL_HEALTH_DRY=1: the health phase must emit its planned key
+    list as one parseable part without touching jax — the round-12
+    analog of the elastic dry-run hook."""
+    env = dict(os.environ, P2PFL_HEALTH_DRY="1")
+    code = (f"import sys; sys.path.insert(0, {str(REPO)!r})\n"
+            "import bench; bench._phase_obs_health()\n")
+    res = subprocess.run([sys.executable, "-c", code],
+                         capture_output=True, text=True, timeout=60,
+                         env=env, cwd=REPO)
+    assert res.returncode == 0, res.stderr[-500:]
+    sys.path.insert(0, str(REPO))
+    import bench
+
+    parts = [json.loads(line[len(bench._PART_TAG):])
+             for line in res.stdout.splitlines()
+             if line.startswith(bench._PART_TAG)]
+    assert len(parts) == 1 and parts[0]["obs_health_dry"] is True
+    planned = set(parts[0]["obs_health_keys"])
+    assert {"obs_health_detect_dead_s", "obs_health_detect_stall_s",
+            "obs_health_overhead_pct", "obs_health_round_s_on",
+            "obs_health_round_s_off",
+            "obs_health_flight_dump_bytes"} <= planned
     assert planned <= set(bench.BENCH_KEYS)
 
 

@@ -1,0 +1,356 @@
+"""Health plane (round 12): the rule engine's firing/clear semantics,
+the healthcheck CLI's exit-code contract, the flight recorder's
+dump-on-crash postmortem, and the bench regression gate.
+
+Engine tests drive ``HealthEngine.evaluate`` with synthetic status
+records and explicit clocks — the engine is read-only over published
+artifacts by design, so no federation needs to run. The dump-on-crash
+test uses the real P2PNode crash path (shared trainer from test_p2p,
+same recompile-amortising reason as test_elastic)."""
+
+import asyncio
+import json
+import os
+import pathlib
+import subprocess
+import sys
+import time
+
+import pytest
+
+from p2pfl_tpu.obs import flight
+from p2pfl_tpu.obs.flight import FlightRecorder
+from p2pfl_tpu.obs.health import (
+    HealthConfig,
+    HealthEngine,
+    evaluate_dir,
+    tail_jsonl,
+    worse,
+)
+from p2pfl_tpu.obs.healthcheck import main as healthcheck_main
+from p2pfl_tpu.utils.monitor import publish_status
+
+from test_p2p import _make_learners
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def _status(node, ts, **fields):
+    return {"node": node, "ts": ts, **fields}
+
+
+# ---------------------------------------------------------------------------
+# rule engine: firing/clear semantics
+# ---------------------------------------------------------------------------
+
+
+class TestHealthEngine:
+    def test_round_stall_fires_then_clears(self):
+        eng = HealthEngine(config=HealthConfig(stall_rounds=2))
+        t = 1000.0
+        lagging = [_status(i, t, round=5) for i in range(3)]
+        lagging.append(_status(3, t, round=2))
+        alerts = eng.evaluate(lagging, now=t)
+        assert [(a.rule, a.node, a.severity) for a in alerts] == [
+            ("round-stall", 3, "warn")
+        ]
+        assert eng.worst() == "warn"
+        # still firing: same alert object identity semantics — ``since``
+        # keeps the original fire time while the message refreshes
+        alerts = eng.evaluate(lagging, now=t + 1)
+        assert alerts[0].since == t
+        # the straggler catches up: the alert must CLEAR, not linger
+        caught_up = [_status(i, t + 2, round=5) for i in range(4)]
+        alerts = eng.evaluate(caught_up, now=t + 2)
+        assert alerts == [] and eng.worst() == "ok"
+        events = [(tr["event"], tr["rule"], tr["node"])
+                  for tr in eng.transitions]
+        assert events == [("fire", "round-stall", 3),
+                          ("clear", "round-stall", 3)]
+
+    def test_stall_clock_judged_against_previous_evaluation(self):
+        # time-based stall (no cohort to lag): the no-advance clock must
+        # be anchored at the PREVIOUS eval's sighting, or a stalled node
+        # would reset it every tick
+        eng = HealthEngine(config=HealthConfig(stall_s=5.0))
+        t = 1000.0
+        rec = [_status(0, t, round=3)]
+        assert eng.evaluate(rec, now=t) == []
+        rec = [_status(0, t + 6, round=3)]  # fresh publish, same round
+        alerts = eng.evaluate(rec, now=t + 6)
+        assert [(a.rule, a.node) for a in alerts] == [("round-stall", 0)]
+        # advancing the round clears it
+        rec = [_status(0, t + 7, round=4)]
+        assert eng.evaluate(rec, now=t + 7) == []
+
+    def test_node_dead_escalates_to_crit_beyond_quorum(self):
+        eng = HealthEngine(config=HealthConfig(liveness_s=10.0))
+        t = 1000.0
+        # one of four silent: warn, per-node only
+        recs = [_status(i, t, round=1) for i in range(3)]
+        recs.append(_status(3, t - 60, round=1))
+        alerts = eng.evaluate(recs, now=t)
+        assert [(a.rule, a.node, a.severity) for a in alerts] == [
+            ("node-dead", 3, "warn")
+        ]
+        # three of four silent: below quorum_frac=0.5 — every dead node
+        # escalates to crit and a federation-level alert (node=None)
+        # names the quorum loss
+        recs = [_status(0, t, round=1)] + [
+            _status(i, t - 60, round=1) for i in (1, 2, 3)
+        ]
+        alerts = eng.evaluate(recs, now=t)
+        assert eng.worst() == "crit"
+        assert {a.node for a in alerts if a.severity == "crit"} \
+            == {None, 1, 2, 3}
+
+    def test_trust_collapse_is_crit(self):
+        eng = HealthEngine()
+        t = 1000.0
+        recs = [_status(0, t, trust=0.9), _status(1, t, trust=0.05)]
+        alerts = eng.evaluate(recs, now=t)
+        assert [(a.rule, a.node, a.severity) for a in alerts] == [
+            ("trust-collapse", 1, "crit")
+        ]
+
+    def test_byte_rate_anomaly_needs_cohort_and_floor(self):
+        cfg = HealthConfig(byte_ratio=8.0, byte_floor=1e6, min_cohort=3)
+        t = 1000.0
+        # 10x the median but only 9 KB over it: below the absolute
+        # floor, so early-round noise must not fire
+        small = [_status(i, t, bytes_out=1e3) for i in range(3)]
+        small.append(_status(3, t, bytes_out=1e4))
+        assert HealthEngine(config=cfg).evaluate(small, now=t) == []
+        big = [_status(i, t, bytes_out=1e6) for i in range(3)]
+        big.append(_status(3, t, bytes_out=2e7))
+        alerts = HealthEngine(config=cfg).evaluate(big, now=t)
+        assert [(a.rule, a.node) for a in alerts] == [("byte-rate", 3)]
+
+    def test_recompile_storm(self):
+        eng = HealthEngine(config=HealthConfig(recompile_storm=32))
+        t = 1000.0
+        recs = [_status(0, t, recompiles=0), _status(1, t, recompiles=40)]
+        alerts = eng.evaluate(recs, now=t)
+        assert [(a.rule, a.node) for a in alerts] \
+            == [("recompile-storm", 1)]
+
+    def test_accuracy_divergence_reads_metrics_fallback(self):
+        eng = HealthEngine(config=HealthConfig(divergence=0.15,
+                                               min_cohort=3))
+        t = 1000.0
+        recs = [_status(i, t, round=1) for i in range(3)]
+        metrics = [
+            {"node": 0, "Test/accuracy": 0.91},
+            {"node": 1, "Test/accuracy": 0.90},
+            {"node": 2, "Test/accuracy": 0.40},  # the poisoned node
+            {"node": 2, "Train/loss": 2.0},  # later non-accuracy row
+        ]
+        alerts = eng.evaluate(recs, metrics, now=t)
+        assert [(a.rule, a.node) for a in alerts] \
+            == [("accuracy-divergence", 2)]
+
+    def test_severity_ordering_helpers(self):
+        assert worse("ok", "warn") == "warn"
+        assert worse("crit", "warn") == "crit"
+        # alerts() sorts crit first, federation-level before nodes
+        eng = HealthEngine(config=HealthConfig(liveness_s=10.0))
+        t = 1000.0
+        recs = [_status(0, t, round=1, trust=0.9)] + [
+            _status(i, t - 60, round=1) for i in (1, 2, 3)
+        ]
+        alerts = eng.evaluate(recs, now=t)
+        assert alerts[0].severity == "crit" and alerts[0].node is None
+
+
+# ---------------------------------------------------------------------------
+# filesystem plumbing + healthcheck CLI
+# ---------------------------------------------------------------------------
+
+
+def test_tail_jsonl_skips_torn_and_clipped_rows(tmp_path):
+    p = tmp_path / "metrics.jsonl"
+    rows = [{"node": i, "Test/accuracy": 0.5} for i in range(5)]
+    with open(p, "w") as f:
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+        f.write('{"node": 9, "Test/acc')  # a writer mid-append
+    out = tail_jsonl(p)
+    assert out == rows  # torn trailing row skipped, never raised
+    # a clipped window must also drop its (possibly partial) first line
+    out = tail_jsonl(p, max_bytes=len(json.dumps(rows[0])) + 30)
+    assert out and all(r in rows for r in out)
+    assert tail_jsonl(tmp_path / "missing.jsonl") == []
+
+
+def test_healthcheck_cli_round_stall_fire_and_clear(tmp_path, capsys):
+    # synthetic scenario dir: status/ subdir + metrics.jsonl, the shape
+    # resolve_dirs() must navigate
+    status = tmp_path / "status"
+    for i in range(3):
+        publish_status(status, i, {"round": 6})
+    publish_status(status, 3, {"round": 1})
+    rc = healthcheck_main([str(tmp_path), "--json"])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 1 and doc["severity"] == "warn"
+    assert [(a["rule"], a["node"]) for a in doc["alerts"]] \
+        == [("round-stall", 3)]
+    # the straggler catches up -> healthy, exit 0
+    publish_status(status, 3, {"round": 6})
+    rc = healthcheck_main([str(tmp_path)])
+    assert rc == 0
+    assert "healthy" in capsys.readouterr().out
+
+
+def test_healthcheck_cli_dead_node_exit_codes(tmp_path, capsys):
+    t = time.time()
+    for i in range(4):
+        ts = t - (100 if i == 3 else 0)
+        (tmp_path / f"node_{i}.status.json").write_text(
+            json.dumps({"node": i, "ts": ts, "round": 2}))
+    assert healthcheck_main([str(tmp_path), "--liveness-s", "10"]) == 1
+    capsys.readouterr()
+    # kill two more: quorum lost, crit, exit 2
+    for i in (1, 2):
+        (tmp_path / f"node_{i}.status.json").write_text(
+            json.dumps({"node": i, "ts": t - 100, "round": 2}))
+    rc = healthcheck_main([str(tmp_path), "--liveness-s", "10", "--json"])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 2 and doc["severity"] == "crit"
+    assert any(a["node"] is None for a in doc["alerts"])  # quorum alert
+
+
+def test_evaluate_dir_shares_engine_state(tmp_path):
+    publish_status(tmp_path, 0, {"round": 4})
+    publish_status(tmp_path, 1, {"round": 1})
+    alerts, eng = evaluate_dir(tmp_path,
+                               HealthEngine(config=HealthConfig()))
+    assert [(a.rule, a.node) for a in alerts] == [("round-stall", 1)]
+    publish_status(tmp_path, 1, {"round": 4})
+    alerts, _ = evaluate_dir(tmp_path, engine=eng)
+    assert alerts == []
+    assert [tr["event"] for tr in eng.transitions] == ["fire", "clear"]
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+
+class TestFlightRecorder:
+    def test_ring_is_bounded_and_disable_is_total(self, tmp_path):
+        rec = FlightRecorder(ring_max=8)
+        for i in range(20):
+            rec.record("evt", i=i)
+        assert len(rec) == 8
+        assert [e["i"] for e in rec.events("evt")] == list(range(12, 20))
+        rec.configure(enabled=False)
+        rec.record("evt", i=99)
+        assert len(rec) == 8  # record() is a no-op when disabled
+        assert rec.dump("why", path=tmp_path / "f.json") is None
+
+    def test_dump_accumulates_reasons(self, tmp_path):
+        rec = FlightRecorder()
+        rec.record("membership.evict", node=2)
+        p = tmp_path / "flight.json"
+        rec.dump("crash", path=p)
+        rec.record("session.close", lane=0)
+        rec.dump("evicted", path=p)
+        doc = json.loads(p.read_text())
+        assert doc["reasons"] == ["crash", "evicted"]
+        kinds = [e["kind"] for e in doc["events"]]
+        assert kinds == ["membership.evict", "session.close"]
+
+    def test_node_crash_dumps_postmortem_with_evict_transition(
+            self, tmp_path):
+        """node.crash() must leave flight_<pid>.json behind, and a
+        membership eviction recorded before the crash must be in it —
+        the postmortem that explains churn without a traced re-run."""
+        from p2pfl_tpu.p2p import P2PNode
+
+        rec = flight.get_recorder()
+        old_dir, old_enabled = rec.dump_dir, rec.enabled
+        rec.clear()
+        flight.configure(enabled=True, dump_dir=tmp_path)
+        try:
+            async def main():
+                _, learners = _make_learners(2, samples=40)
+                node = P2PNode(0, learners[0], role="aggregator",
+                               n_nodes=2)
+                node.membership.evict(1)
+                await node.crash()
+                return node
+
+            node = asyncio.run(main())
+            assert node.finished.is_set()
+            dump = tmp_path / f"flight_{os.getpid()}.json"
+            assert dump.exists()
+            doc = json.loads(dump.read_text())
+            assert "node0.crash" in doc["reasons"]
+            kinds = [e["kind"] for e in doc["events"]]
+            assert "membership.evict" in kinds
+            assert "node.crash" in kinds
+            evict = next(e for e in doc["events"]
+                         if e["kind"] == "membership.evict")
+            assert evict["node"] == 1
+        finally:
+            rec.dump_dir, rec.enabled = old_dir, old_enabled
+            rec.clear()
+
+
+# ---------------------------------------------------------------------------
+# bench regression gate
+# ---------------------------------------------------------------------------
+
+
+def _socket_best():
+    vals = []
+    for p in sorted(REPO.glob("BENCH_r*.json")):
+        doc = json.loads(p.read_text())
+        if doc.get("rc") not in (0, None):
+            continue
+        v = (doc.get("parsed") or {}).get("socket_round_s_24node")
+        if isinstance(v, (int, float)):
+            vals.append(float(v))
+    return min(vals)
+
+
+def test_check_bench_regress_clean_over_trajectory():
+    """The gate must pass over the checked-in history itself — and
+    auto-skip the timed-out r03 instead of anchoring on it."""
+    res = subprocess.run(
+        [sys.executable, str(REPO / "scripts" / "check_bench_regress.py")],
+        capture_output=True, text=True, timeout=60, cwd=REPO)
+    assert res.returncode == 0, res.stdout + res.stderr[-500:]
+    assert "clean" in res.stdout
+    assert "skipping BENCH_r03" in res.stdout
+
+
+def test_check_bench_regress_fails_synthetic_regression(tmp_path):
+    cand = {"metric": "synthetic", "unit": "s/round",
+            "socket_round_s_24node": _socket_best() * 1.30,
+            "meta": {"git_sha": "deadbee", "host": "test"}}
+    p = tmp_path / "BENCH_cand.json"
+    p.write_text(json.dumps(cand))
+    res = subprocess.run(
+        [sys.executable, str(REPO / "scripts" / "check_bench_regress.py"),
+         "--candidate", str(p)],
+        capture_output=True, text=True, timeout=60, cwd=REPO)
+    assert res.returncode == 1, res.stdout + res.stderr[-500:]
+    assert "REGRESSION" in res.stdout
+    assert "FAIL" in res.stderr
+    # the provenance stamp must be surfaced next to the verdict
+    assert "git_sha=deadbee" in res.stdout
+
+
+def test_check_bench_regress_within_tolerance_passes(tmp_path):
+    cand = {"metric": "synthetic",
+            "socket_round_s_24node": _socket_best() * 1.05}
+    p = tmp_path / "BENCH_cand.json"
+    p.write_text(json.dumps(cand))
+    res = subprocess.run(
+        [sys.executable, str(REPO / "scripts" / "check_bench_regress.py"),
+         "--candidate", str(p)],
+        capture_output=True, text=True, timeout=60, cwd=REPO)
+    # one key 5% off best + six missing keys (reported, not failed)
+    assert res.returncode == 0, res.stdout + res.stderr[-500:]
+    assert res.stdout.count("missing") >= 5

@@ -107,6 +107,8 @@ def test_profiler_hook_writes_trace(tmp_path):
 
 
 def test_compose_generation_and_cleanup(tmp_path):
+    # encrypt=True mints real TLS material at generation time
+    pytest.importorskip("cryptography")
     from p2pfl_tpu.deploy import cleanup, generate_compose
 
     cfg = ScenarioConfig(

@@ -1005,6 +1005,18 @@ _HEALTH_KEYS = (
     "obs_health_flight_dump_bytes",
 )
 
+# keys the cross_device phase (round 13: K-of-N sampling + cohort
+# scan) emits; static so BENCH_KEYS and the P2PFL_CROSSDEV_DRY plan
+# stay authoritative
+_CROSSDEV_KEYS = (
+    "crossdev_round_s_10k", "crossdev_clients_per_s",
+    "crossdev_n_clients", "crossdev_clients_per_round",
+    "crossdev_cohort_size", "crossdev_xla_recompiles",
+    "crossdev_cohort_scaling",
+    "crossdev_rounds_to_target", "crossdev_target_accuracy",
+    "crossdev_final_acc",
+)
+
 # Authoritative registry of every top-level key bench can emit.
 # scripts/check_bench_keys.py asserts each one is documented in
 # docs/perf.md (§10 key reference) and that no emission site uses a
@@ -1050,6 +1062,8 @@ BENCH_KEYS = (
     "elastic_dry", "elastic_keys", *_ELASTIC_KEYS,
     # obs_health (round 12: live anomaly detection + flight recorder)
     "obs_health_dry", "obs_health_keys", *_HEALTH_KEYS,
+    # cross_device (round 13: K-of-N sampling + cohort-scan rounds)
+    "crossdev_dry", "crossdev_keys", *_CROSSDEV_KEYS,
     # run-metadata stamp (round 12 regression gate provenance)
     "meta",
     # orchestration-test hook
@@ -1920,6 +1934,107 @@ print("BENCH_ELASTIC " + json.dumps({"sync": sync, "async": asy}),
               flush=True)
 
 
+def _phase_cross_device() -> None:
+    """Cross-device scale (round 13: K-of-N sampling + cohort scan).
+
+    (a) headline — a 10,000-client federation, K=256 sampled per round
+        at cohort_size=32 (8 simulation slots): one warm-up round
+        compiles the cohort-scan program, then 5 timed rounds report
+        the median ``crossdev_round_s_10k`` and the derived
+        ``crossdev_clients_per_s``. ``crossdev_xla_recompiles`` counts
+        backend compiles AFTER the warm-up — resampling clients every
+        round must stay at 0 (fixed cohort shapes are the whole
+        design).
+    (b) cohort scaling — same K=256 out of N=2048 at cohort_size in
+        {4, 16, 64} (64/16/4 slots): how round time trades scan depth
+        against simulation width.
+    (c) time-to-quality — N=2048, K=256, cohort_size=16, eval every
+        round against a 0.8 central-test target
+        (``crossdev_rounds_to_target``).
+
+    ``P2PFL_CROSSDEV_DRY=1`` emits the key plan without touching the
+    accelerator — the orchestration test's smoke hook."""
+    if os.environ.get("P2PFL_CROSSDEV_DRY") == "1":
+        _part({"crossdev_dry": True,
+               "crossdev_keys": list(_CROSSDEV_KEYS)})
+        return
+
+    from p2pfl_tpu.config.schema import (
+        CrossDeviceConfig,
+        DataConfig,
+        ScenarioConfig,
+        TrainingConfig,
+    )
+    from p2pfl_tpu.federation.scenario import CrossDeviceScenario
+    from p2pfl_tpu.obs import trace as obs_trace
+
+    def cfg(n_clients: int, cohort: int, train_n: int,
+            eval_every: int = 0) -> ScenarioConfig:
+        return ScenarioConfig(
+            name="crossdev", n_nodes=4,  # unused by the sampled regime
+            data=DataConfig(dataset="mnist", synthetic_train=train_n,
+                            synthetic_test=2000, batch_size=32),
+            training=TrainingConfig(rounds=5, epochs_per_round=1,
+                                    learning_rate=0.1,
+                                    eval_every=eval_every),
+            cross_device=CrossDeviceConfig(
+                n_clients=n_clients, clients_per_round=256,
+                cohort_size=cohort, sampling="uniform", seed=0,
+            ),
+            seed=0,
+        )
+
+    def median_round_s(sc: CrossDeviceScenario, rounds: int) -> float:
+        res = sc.run(rounds=rounds)
+        times = sorted(res.round_times_s)
+        return times[len(times) // 2]
+
+    # ---- (a) 10k-client headline ------------------------------------
+    try:
+        sc = CrossDeviceScenario(cfg(10_000, 32, 50_000))
+        sc.run(rounds=1)  # warm-up: compile the cohort-scan program
+        obs_trace.reset_xla_counters()
+        med = median_round_s(sc, 5)
+        _part({
+            "crossdev_round_s_10k": round(med, 4),
+            "crossdev_clients_per_s": round(256 / med, 1),
+            "crossdev_n_clients": 10_000,
+            "crossdev_clients_per_round": 256,
+            "crossdev_cohort_size": 32,
+            "crossdev_xla_recompiles": obs_trace.xla_recompiles(),
+        })
+        sc.close()
+    except Exception as e:
+        print(f"crossdev 10k arm failed: {e!r}"[:300], file=sys.stderr,
+              flush=True)
+
+    # ---- (b) cohort-size scaling at N=2048 --------------------------
+    try:
+        scaling = {}
+        for cohort in (4, 16, 64):
+            sc = CrossDeviceScenario(cfg(2048, cohort, 40_960))
+            sc.run(rounds=1)
+            scaling[str(cohort)] = round(median_round_s(sc, 3), 4)
+            sc.close()
+        _part({"crossdev_cohort_scaling": scaling})
+    except Exception as e:
+        print(f"crossdev scaling arm failed: {e!r}"[:300],
+              file=sys.stderr, flush=True)
+
+    # ---- (c) rounds-to-target ---------------------------------------
+    try:
+        target = 0.8
+        sc = CrossDeviceScenario(cfg(2048, 16, 40_960, eval_every=1))
+        res = sc.run(rounds=15, target_accuracy=target)
+        _part({"crossdev_target_accuracy": target,
+               "crossdev_rounds_to_target": res.rounds_to_target,
+               "crossdev_final_acc": round(res.final_accuracy, 4)})
+        sc.close()
+    except Exception as e:
+        print(f"crossdev quality arm failed: {e!r}"[:300],
+              file=sys.stderr, flush=True)
+
+
 def _run_meta() -> dict:
     """Provenance stamp for every BENCH json — what
     scripts/check_bench_regress.py prints next to its verdict, so a
@@ -2090,6 +2205,7 @@ def main() -> None:
         ("obs_health", "_phase_obs_health", 120),
         ("robust", "_phase_robust", 150),
         ("elastic", "_phase_elastic", 150),
+        ("cross_device", "_phase_cross_device", 120),
         ("vit32", "_phase_vit32", 120),
     ]
     for name, fn, min_s in phases:

@@ -269,6 +269,75 @@ class ElasticConfig:
 
 
 @dataclasses.dataclass
+class CrossDeviceConfig:
+    """Cross-device regime (round 13): N virtual clients, K sampled
+    per round, simulated by scanning cohorts over the device mesh.
+
+    The cross-silo planes keep one live row (SPMD) or process (socket)
+    per participant, which tops out near the device/process count. Here
+    a client is a partition index plus optional personal leaves — not a
+    live process: each round draws ``clients_per_round`` of
+    ``n_clients`` (seeded, replacement-free, optionally weighted by
+    data size), groups them into ``cohort_size`` waves per simulation
+    slot, and one compiled round fn scans the cohorts (FedJAX's
+    sampled-client simulation idiom, PAPERS.md).
+
+    ``n_clients == 0`` (default) keeps cross-device off. When active,
+    the simulation width is derived: ``n_slots = clients_per_round /
+    cohort_size`` — the stacked axis the mesh shards, while the scan
+    runs ``cohort_size`` steps. Cohort shapes are fixed across rounds,
+    so the whole run is one compiled program (zero mid-run recompiles,
+    pinned by the bench's recompile counter).
+    """
+
+    n_clients: int = 0  # total virtual clients; 0 = off
+    clients_per_round: int = 0  # K sampled per round
+    cohort_size: int = 1  # clients per simulation slot (scan length)
+    sampling: str = "uniform"  # uniform | weighted (by client data size)
+    seed: int = 0
+
+    def __post_init__(self):
+        if self.sampling not in ("uniform", "weighted"):
+            raise ValueError(
+                f"unknown sampling {self.sampling!r}; "
+                "have ('uniform', 'weighted')"
+            )
+        if self.n_clients < 0:
+            raise ValueError(f"n_clients must be >= 0, got {self.n_clients}")
+        if not self.active:
+            return
+        if self.clients_per_round < 1:
+            raise ValueError(
+                "cross_device needs clients_per_round >= 1 "
+                f"(got {self.clients_per_round})"
+            )
+        if self.clients_per_round > self.n_clients:
+            raise ValueError(
+                f"clients_per_round={self.clients_per_round} > "
+                f"n_clients={self.n_clients}"
+            )
+        if self.cohort_size < 1:
+            raise ValueError(
+                f"cohort_size must be >= 1, got {self.cohort_size}"
+            )
+        if self.clients_per_round % self.cohort_size:
+            raise ValueError(
+                f"clients_per_round={self.clients_per_round} must be a "
+                f"multiple of cohort_size={self.cohort_size} (the round "
+                "scans cohort_size waves of equal width)"
+            )
+
+    @property
+    def active(self) -> bool:
+        return self.n_clients > 0
+
+    @property
+    def n_slots(self) -> int:
+        """Simulation width: clients trained in parallel per scan step."""
+        return self.clients_per_round // self.cohort_size
+
+
+@dataclasses.dataclass
 class NodeConfig:
     """Per-node overrides (device_args in the reference), including the
     round-11 compute class: ``epochs`` overrides the federation-wide
@@ -312,6 +381,14 @@ class ScenarioConfig:
         default_factory=AdversaryConfig
     )
     elastic: ElasticConfig = dataclasses.field(default_factory=ElasticConfig)
+    # cross-device regime (round 13): N virtual clients, K-of-N sampled
+    # rounds scanned in cohorts over the mesh. Inactive by default;
+    # when active the scenario runs through CrossDeviceScenario and
+    # n_nodes/topology describe nothing (the width is derived from the
+    # cohort geometry).
+    cross_device: CrossDeviceConfig = dataclasses.field(
+        default_factory=CrossDeviceConfig
+    )
     # weight-exchange collective schedule: "dense" = all-gather einsum;
     # "sparse" = per-edge-offset ppermute (O(degree) ICI traffic, DFL +
     # one node per device only); "auto" picks sparse when it is legal
@@ -372,6 +449,26 @@ class ScenarioConfig:
             )
         if self.n_nodes < 1:
             raise ValueError("n_nodes must be >= 1")
+        if self.cross_device.active:
+            # fail loud on combinations the cohort-scan round has no
+            # hook for, instead of silently simulating something else
+            # (the sparse-transport refusal idiom)
+            if self.adversary.active or self.adversary.reputation:
+                raise ValueError(
+                    "cross_device composes with no adversary/reputation "
+                    "config yet: sampled clients are stateless rows, so "
+                    "there is no per-node trust or poisoning hook"
+                )
+            if self.exchange_overlap != "off":
+                raise ValueError(
+                    "cross_device requires exchange_overlap='off': a "
+                    "sampled cohort has no previous-round buffer to ship"
+                )
+            if self.transport == "sparse":
+                raise ValueError(
+                    "cross_device uses the cohort-scan round, not the "
+                    "ppermute transport; leave transport 'auto'/'dense'"
+                )
         if not self.nodes:
             self.nodes = self._default_nodes()
         if len(self.nodes) != self.n_nodes:
@@ -459,6 +556,7 @@ class ScenarioConfig:
             ("network", NetworkConfig),
             ("adversary", AdversaryConfig),
             ("elastic", ElasticConfig),
+            ("cross_device", CrossDeviceConfig),
         ]:
             if field in d and isinstance(d[field], dict):
                 d[field] = cls(**d[field])

@@ -15,22 +15,31 @@ e.g. femnist.py:24-77).
 """
 
 from p2pfl_tpu.datasets.partition import (
+    ClientPartition,
     dirichlet_partition,
     iid_partition,
+    lazy_partition_indices,
     partition_indices,
     sorted_partition,
 )
 from p2pfl_tpu.datasets.sources import DATASETS, DatasetSplits, get_dataset
-from p2pfl_tpu.datasets.data import FederatedDataset, NodeData
+from p2pfl_tpu.datasets.data import (
+    CrossDeviceData,
+    FederatedDataset,
+    NodeData,
+)
 
 __all__ = [
+    "ClientPartition",
     "dirichlet_partition",
     "iid_partition",
+    "lazy_partition_indices",
     "partition_indices",
     "sorted_partition",
     "DATASETS",
     "DatasetSplits",
     "get_dataset",
+    "CrossDeviceData",
     "FederatedDataset",
     "NodeData",
 ]

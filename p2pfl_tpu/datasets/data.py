@@ -19,7 +19,11 @@ import dataclasses
 import numpy as np
 
 from p2pfl_tpu.config.schema import DataConfig
-from p2pfl_tpu.datasets.partition import partition_indices
+from p2pfl_tpu.datasets.partition import (
+    ClientPartition,
+    lazy_partition_indices,
+    partition_indices,
+)
 from p2pfl_tpu.datasets.sources import DatasetSplits, get_dataset
 
 
@@ -122,5 +126,106 @@ class FederatedDataset:
             nodes=nodes,
             x_test=splits.x_test,
             y_test=splits.y_test,
+            synthetic=splits.synthetic,
+        )
+
+
+@dataclasses.dataclass
+class CrossDeviceData:
+    """Cross-device dataset view (round 13): client-state-as-index.
+
+    At N=10k–1M virtual clients the :class:`FederatedDataset` recipe —
+    N eager ``NodeData`` shards — is both the setup bottleneck and a
+    memory multiplier. Here a client IS its row in a lazy
+    :class:`ClientPartition`; actual arrays materialize per round, only
+    for the K sampled clients, at one FIXED shard size ``shard_size``
+    so every round's cohort batch has identical shapes (one compiled
+    round program, zero mid-run recompiles).
+
+    No per-client val split: sampled clients are transient, so quality
+    tracking is central (the shared test set), like every cross-device
+    system FedJAX models.
+    """
+
+    name: str
+    num_classes: int
+    input_shape: tuple[int, ...]
+    x_train: np.ndarray
+    y_train: np.ndarray
+    part: ClientPartition
+    x_test: np.ndarray
+    y_test: np.ndarray
+    shard_size: int  # fixed pad target for every materialized shard
+    seed: int = 0
+    synthetic: bool = False
+
+    @property
+    def n_clients(self) -> int:
+        return self.part.n_clients
+
+    @property
+    def client_sizes(self) -> np.ndarray:
+        """Effective (cap-clamped) per-client sample counts — the
+        FedAvg weights and the weighted-sampling distribution."""
+        return np.minimum(self.part.sizes(), self.shard_size)
+
+    def cohort_batch(self, client_ids: np.ndarray):
+        """Materialize the sampled clients' shards, padded to
+        ``shard_size``: ``(x [k,S,...], y [k,S], mask [k,S],
+        n_samples [k])``. Each client's rows are drawn through a
+        per-client seeded shuffle before the cap — dirichlet partitions
+        are label-grouped, and an unshuffled head slice would be
+        single-label (the FederatedDataset.make guard, applied lazily).
+        """
+        k = len(client_ids)
+        s = self.shard_size
+        x = np.zeros((k, s) + self.input_shape, np.float32)
+        y = np.zeros((k, s), np.int32)
+        mask = np.zeros((k, s), bool)
+        sizes = np.zeros((k,), np.int32)
+        for j, cid in enumerate(client_ids):
+            idx = self.part.client_indices(int(cid))
+            rng = np.random.default_rng(self.seed * 100003 + int(cid))
+            idx = rng.permutation(idx)[:s]
+            m = len(idx)
+            x[j, :m] = self.x_train[idx]
+            y[j, :m] = self.y_train[idx]
+            mask[j, :m] = True
+            sizes[j] = m
+        return x, y, mask, sizes
+
+    @staticmethod
+    def make(config: DataConfig, n_clients: int) -> "CrossDeviceData":
+        """Build the lazy N-client view per the DataConfig scheme.
+        ``samples_per_node`` caps (and thereby fixes) the shard size;
+        without it the pad target is the largest client shard."""
+        sizes = (
+            (config.synthetic_train, config.synthetic_test or 4000)
+            if config.synthetic_train else None
+        )
+        splits = get_dataset(config.dataset, seed=config.seed,
+                             synthetic_sizes=sizes,
+                             profile=getattr(config, "surrogate_profile",
+                                             "hard"))
+        part = lazy_partition_indices(
+            splits.y_train, n_clients, scheme=config.partition,
+            seed=config.seed, alpha=config.dirichlet_alpha,
+        )
+        largest = int(part.sizes().max())
+        shard = (
+            min(config.samples_per_node, largest)
+            if config.samples_per_node is not None else largest
+        )
+        return CrossDeviceData(
+            name=splits.name,
+            num_classes=splits.num_classes,
+            input_shape=splits.input_shape,
+            x_train=splits.x_train,
+            y_train=splits.y_train,
+            part=part,
+            x_test=splits.x_test,
+            y_test=splits.y_test,
+            shard_size=shard,
+            seed=config.seed,
             synthetic=splits.synthetic,
         )

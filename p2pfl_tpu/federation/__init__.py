@@ -11,16 +11,23 @@ alive mask) to the jitted dataplane in p2pfl_tpu.parallel.
 
 from p2pfl_tpu.federation.events import Events, Observable, Observer
 from p2pfl_tpu.federation.membership import Membership
+from p2pfl_tpu.federation.sampling import sample_clients
 from p2pfl_tpu.federation.checkpoint import load_checkpoint, save_checkpoint
-from p2pfl_tpu.federation.scenario import Scenario, ScenarioResult
+from p2pfl_tpu.federation.scenario import (
+    CrossDeviceScenario,
+    Scenario,
+    ScenarioResult,
+)
 
 __all__ = [
     "Events",
     "Observable",
     "Observer",
     "Membership",
+    "sample_clients",
     "load_checkpoint",
     "save_checkpoint",
+    "CrossDeviceScenario",
     "Scenario",
     "ScenarioResult",
 ]

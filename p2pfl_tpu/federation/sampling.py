@@ -1,0 +1,68 @@
+"""K-of-N client sampling for the cross-device regime (round 13).
+
+Cross-device FL never has all N clients in a round: each round draws a
+cohort of K participants (FedJAX's sampled-client idiom, PAPERS.md).
+The draw must be
+
+- **seeded + round-keyed**: every process that knows ``(seed, round)``
+  reproduces the same cohort, so a restarted or remote driver agrees
+  with the bench record without any coordination message;
+- **replacement-free**: a client appears at most once per round, so
+  FedAvg's example-count weights are well defined;
+- **optionally data-weighted**: clients holding more examples are
+  sampled proportionally more often (the classic unbiased-FedAvg
+  configuration when combined with uniform aggregation weights).
+
+Dead clients are NOT filtered here — fault composition happens at the
+cohort level (a sampled-but-dead client's slot is masked out of
+training and aggregation by the ``membership.py`` alive vector), so the
+sample stream itself stays independent of churn history and therefore
+reproducible from ``(seed, round)`` alone.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+# Domain-separation constant folded into the per-round generator key so
+# cohort draws never collide with other consumers of the scenario seed
+# (data shuffles use seed*100003+cid, membership uses raw seed).
+_SAMPLER_DOMAIN = 0x5A3C
+
+
+def sample_clients(
+    n_clients: int,
+    k: int,
+    round_num: int,
+    seed: int = 0,
+    weights: np.ndarray | None = None,
+) -> np.ndarray:
+    """Draw K of N client ids for ``round_num`` — deterministic in
+    ``(seed, round_num)``, without replacement.
+
+    ``weights`` (e.g. per-client data sizes) biases the draw; they are
+    normalized here and need not sum to 1. Zero-weight clients are
+    never drawn, so there must be at least ``k`` positive weights.
+    """
+    if k < 1 or k > n_clients:
+        raise ValueError(f"cannot sample k={k} of n_clients={n_clients}")
+    rng = np.random.default_rng([seed, round_num, _SAMPLER_DOMAIN])
+    p = None
+    if weights is not None:
+        w = np.asarray(weights, np.float64)
+        if w.shape != (n_clients,):
+            raise ValueError(
+                f"weights shape {w.shape} != ({n_clients},)"
+            )
+        if np.any(w < 0) or not np.all(np.isfinite(w)):
+            raise ValueError("sampling weights must be finite and >= 0")
+        total = w.sum()
+        if total <= 0:
+            raise ValueError("sampling weights sum to zero")
+        if np.count_nonzero(w) < k:
+            raise ValueError(
+                f"only {np.count_nonzero(w)} clients have positive "
+                f"weight; cannot draw k={k} without replacement"
+            )
+        p = w / total
+    return rng.choice(n_clients, size=k, replace=False, p=p).astype(np.int64)

@@ -36,7 +36,7 @@ from p2pfl_tpu.adversary import (
 )
 from p2pfl_tpu.config.schema import ScenarioConfig
 from p2pfl_tpu.core.aggregators import FedAvg, get_aggregator
-from p2pfl_tpu.datasets import FederatedDataset
+from p2pfl_tpu.datasets import CrossDeviceData, FederatedDataset
 from p2pfl_tpu.federation.checkpoint import (
     all_checkpoints,
     load_checkpoint,
@@ -44,12 +44,14 @@ from p2pfl_tpu.federation.checkpoint import (
 )
 from p2pfl_tpu.federation.events import Events, Observable
 from p2pfl_tpu.federation.membership import Membership
+from p2pfl_tpu.federation.sampling import sample_clients
 from p2pfl_tpu.learning.learner import make_step_fns
 from p2pfl_tpu.models.base import build_model
 from p2pfl_tpu.parallel.federated import (
     FederatedState,
     build_eval_fn,
     build_round_fn,
+    build_round_fn_cross_device,
     build_round_fn_sparse,
     init_federation,
     make_round_plan,
@@ -84,6 +86,12 @@ class Scenario(Observable):
 
     def __init__(self, config: ScenarioConfig, dataset: FederatedDataset | None = None):
         super().__init__()
+        if config.cross_device.active:
+            raise ValueError(
+                "config.cross_device is active — Scenario drives one "
+                "live row per node; use CrossDeviceScenario for the "
+                "sampled K-of-N regime"
+            )
         self.config = config
         n = config.n_nodes
         self.dataset = dataset or FederatedDataset.make(config.data, n)
@@ -656,6 +664,203 @@ class Scenario(Observable):
 
         last_round = start_round + rounds - 1
         if ev is None or ev_round != last_round:  # don't report stale eval
+            ev = self.evaluate()
+            if (target_accuracy is not None and rounds_to_target is None
+                    and ev["mean_accuracy"] >= target_accuracy):
+                rounds_to_target = last_round + 1
+        self.notify(Events.LEARNING_FINISHED, {})
+        return ScenarioResult(
+            final_accuracy=ev["mean_accuracy"],
+            per_node_accuracy=ev["per_node_accuracy"],
+            rounds_run=rounds,
+            round_times_s=round_times,
+            history=self.logger.history,
+            rounds_to_target=rounds_to_target,
+            min_accuracy=ev["min_accuracy"],
+        )
+
+    def close(self) -> None:
+        self.logger.close()
+
+
+class CrossDeviceScenario(Observable):
+    """Sampled K-of-N cross-device driver (round 13).
+
+    A client here is NOT a live row of the federation: it is an index
+    into a lazy :class:`ClientPartition` (CrossDeviceData). Per round
+    the host (1) applies scheduled faults and advances the SAME
+    ``membership.py`` virtual clock Scenario uses — but over ALL
+    ``n_clients`` virtual clients, so churn composes with sampling,
+    (2) draws K clients (seeded by ``(cross_device.seed, round)``,
+    replacement-free, optionally data-size-weighted), (3) reshapes them
+    into ``cohort_size`` cohorts of ``n_slots`` and materializes their
+    shards at the fixed shard size, (4) invokes the compiled
+    cohort-scan round (``build_round_fn_cross_device``): one program,
+    fixed shapes, zero steady-state recompiles regardless of which
+    clients were drawn. A sampled-but-dead client simply rides through
+    with zero training gate and zero aggregation weight.
+
+    The mesh is ``n_slots = clients_per_round / cohort_size`` wide —
+    an 8-slot dev mesh at cohort_size=32 simulates 256 participants
+    per round out of a 10k–1M population.
+    """
+
+    def __init__(self, config: ScenarioConfig,
+                 dataset: CrossDeviceData | None = None):
+        super().__init__()
+        cd = config.cross_device
+        if not cd.active:
+            raise ValueError(
+                "CrossDeviceScenario needs config.cross_device.n_clients"
+                " > 0"
+            )
+        self.config = config
+        self.cd = cd
+        self.data = dataset or CrossDeviceData.make(config.data,
+                                                    cd.n_clients)
+        self.model = build_model(config.model)
+        self.fns = make_step_fns(
+            self.model,
+            objective=config.model.objective,
+            optimizer=config.training.optimizer,
+            learning_rate=config.training.learning_rate,
+            momentum=config.training.momentum,
+            weight_decay=config.training.weight_decay,
+            momentum_dtype=config.training.momentum_dtype,
+            batch_size=config.data.batch_size,
+        )
+        # the virtual clock spans every VIRTUAL client — the same
+        # heartbeat/eviction law as the per-node plane, just wider
+        self.membership = Membership(cd.n_clients, config.protocol)
+        self._faults_by_round: dict[int, list] = {}
+        for f in config.faults:
+            self._faults_by_round.setdefault(f.round, []).append(f)
+        self._sample_weights = (
+            self.data.client_sizes.astype(np.float64)
+            if cd.sampling == "weighted" else None
+        )
+        self._proc0 = jax.process_index() == 0
+        self.logger = MetricsLogger(
+            config.log_dir if self._proc0 else None, config.name,
+            tensorboard=config.tensorboard,
+            wandb=config.wandb and self._proc0,
+        )
+        self.transport = MeshTransport(cd.n_slots)
+        self._exchange_dtype = (
+            jnp.bfloat16 if config.wire_dtype in ("bf16", "int8") else None
+        )
+        round_fn = build_round_fn_cross_device(
+            self.fns,
+            epochs=config.training.epochs_per_round,
+            exchange_dtype=self._exchange_dtype,
+        )
+        self._round_fn = self.transport.compile_round(round_fn)
+        self._eval_fn = self.transport.compile_eval(build_eval_fn(self.fns))
+        sample_x = jnp.zeros((1,) + self.data.input_shape, jnp.float32)
+        self.fed = self.transport.put_stacked(
+            init_federation(self.fns, sample_x, cd.n_slots,
+                            seed=config.seed)
+        )
+        self._x_test = self.transport.put_replicated(
+            jnp.asarray(self.data.x_test))
+        self._y_test = self.transport.put_replicated(
+            jnp.asarray(self.data.y_test))
+        # test introspection: the last round's draw and its liveness
+        self.last_sampled: np.ndarray | None = None
+        self.last_cohorts: np.ndarray | None = None
+        self.last_cohort_alive: np.ndarray | None = None
+
+    def _advance_membership(self, round_num: int) -> np.ndarray:
+        for fault in self._faults_by_round.get(round_num, []):
+            # join == recover here: clients are stateless between
+            # rounds, so there is no row to state-sync
+            self.membership.apply_fault(fault)
+        t = (self.membership.clock
+             + self.membership.protocol.heartbeat_period_s)
+        return self.membership.advance_to(t)
+
+    def evaluate(self) -> dict[str, Any]:
+        """Central-test-set quality of the global model. Every slot
+        holds the same aggregate post-round, so slot metrics agree; the
+        mean is reported for symmetry with Scenario.evaluate."""
+        metrics = self._eval_fn(self.fed, self._x_test, self._y_test)
+        acc = np.asarray(metrics["accuracy"]).astype(np.float64)
+        loss = np.asarray(metrics["loss"]).astype(np.float64)
+        return {
+            "per_node_accuracy": [float(a) for a in acc],
+            "per_node_loss": [float(l) for l in loss],
+            "mean_accuracy": float(acc.mean()),
+            "min_accuracy": float(acc.min()),
+        }
+
+    def run(self, rounds: int | None = None,
+            target_accuracy: float | None = None) -> ScenarioResult:
+        cfg = self.config
+        cd = self.cd
+        rounds = rounds if rounds is not None else cfg.training.rounds
+        obs_trace.install_xla_listener()
+        round_times: list[float] = []
+        rounds_to_target = None
+        ev = None
+        ev_round = -1
+        start_round = int(np.asarray(self.fed.round))
+        tr = self.transport
+        for r in range(start_round, start_round + rounds):
+            t0 = time.monotonic()
+            self.notify(Events.ROUND_STARTED, {"round": r})
+            alive = self._advance_membership(r)
+            sampled = sample_clients(
+                cd.n_clients, cd.clients_per_round, r, seed=cd.seed,
+                weights=self._sample_weights,
+            )
+            # row-major reshape: cohort step t runs clients
+            # sampled[t*n_slots:(t+1)*n_slots]
+            cohorts = sampled.reshape(cd.cohort_size, cd.n_slots)
+            c_alive = alive[cohorts]
+            x, y, mask, sizes = self.data.cohort_batch(sampled)
+            shape2 = (cd.cohort_size, cd.n_slots)
+            # leading axis is the SCAN axis (cohort_size), not the slot
+            # axis — replicate; the per-slot split happens inside the
+            # compiled round
+            args = tuple(
+                tr.put_replicated(jnp.asarray(a.reshape(
+                    shape2 + a.shape[1:])))
+                for a in (x, y, mask, sizes)
+            ) + (tr.put_replicated(jnp.asarray(c_alive)),)
+            self.fed, metrics = self._round_fn(self.fed, *args)
+            jax.block_until_ready(self.fed.states.params)
+            dt = time.monotonic() - t0
+            round_times.append(dt)
+            self.last_sampled = sampled
+            self.last_cohorts = cohorts
+            self.last_cohort_alive = c_alive
+            self.notify(Events.AGGREGATION_FINISHED, {"round": r})
+
+            losses = np.asarray(metrics["train_loss"]).astype(np.float64)
+            live = c_alive.astype(bool)
+            mean_loss = float(losses[live].mean()) if live.any() else 0.0
+            self.logger.log_metrics(
+                {"Train/loss": mean_loss,
+                 "Train/round_time_s": dt,
+                 "CrossDev/clients_sampled": int(len(sampled)),
+                 "CrossDev/clients_alive": int(live.sum())},
+                step=r, round=r,
+            )
+            if cfg.training.eval_every and (r + 1) % cfg.training.eval_every == 0:
+                ev = self.evaluate()
+                ev_round = r
+                self.logger.log_metrics(
+                    {"Test/mean_accuracy": ev["mean_accuracy"]},
+                    step=r, round=r,
+                )
+                if (target_accuracy is not None
+                        and rounds_to_target is None
+                        and ev["mean_accuracy"] >= target_accuracy):
+                    rounds_to_target = r + 1
+            self.notify(Events.ROUND_FINISHED, {"round": r, "time_s": dt})
+
+        last_round = start_round + rounds - 1
+        if ev is None or ev_round != last_round:
             ev = self.evaluate()
             if (target_accuracy is not None and rounds_to_target is None
                     and ev["mean_accuracy"] >= target_accuracy):

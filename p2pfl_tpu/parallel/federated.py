@@ -563,6 +563,125 @@ def build_round_fn_sparse(
     return sharded
 
 
+def build_round_fn_cross_device(
+    fns: StepFns,
+    epochs: int = 1,
+    exchange_dtype: Any | None = None,
+) -> Callable:
+    """The cross-device round (round 13): one compiled program runs a
+    ``lax.scan`` over stacked cohorts, so an ``n_slots``-wide mesh
+    simulates ``cohort_size x n_slots`` sampled participants per round.
+
+    Signature: ``round_fn(fed, cx, cy, cmask, c_sizes, c_alive) ->
+    (fed, metrics)`` with cohort-stacked data ``cx [C, n_slots, S,
+    ...]``, ``cy/cmask [C, n_slots, S]``, ``c_sizes/c_alive [C,
+    n_slots]`` (``C = cohort_size``). ``fed`` is the GLOBAL model
+    broadcast across slots (init_federation same_init) — clients are
+    transient, so every scan step trains its cohort from the
+    round-start params, and the example-weighted FedAvg sums over all
+    ``C x n_slots`` sampled clients at once: per step the accumulator
+    gains ``dot(W_t, flat_t)`` where every row of ``W_t`` is that
+    cohort's slice of the globally normalized weights ``wn = w /
+    max(sum(w), 1e-9)``. Every slot therefore holds the same aggregate
+    afterwards — the cross-device analog of fully-connected DFL, and
+    deliberately the SAME dot shape ([n_slots, n_slots] @ [n_slots,
+    d]), operand order and f32 accumulation as the dense round's
+    ``leaf_mix``: at ``cohort_size == 1`` with every client sampled the
+    two programs are bit-identical (the parity gate in
+    tests/test_cross_device.py).
+
+    A sampled-but-dead client (``c_alive`` false — membership clock
+    composition) trains nothing (the ``_train_and_select`` gate) and
+    carries zero aggregation weight; its slot's data that step is inert
+    padding. Optimizer state / rng / step thread through the scan as
+    slot-level carries (cross-device clients own no persistent state).
+    ``exchange_dtype`` rounds each cohort's params entering the
+    accumulation dot, mirroring the dense wire-precision knob.
+
+    All shapes are fixed by ``(n_slots, C, shard_size)`` — resampling
+    clients each round never recompiles (the crossdev_xla_recompiles
+    bench key pins this).
+    """
+
+    def round_fn(fed: FederatedState, cx, cy, cmask, c_sizes, c_alive):
+        n_slots = fed.alive.shape[0]
+        params0 = fed.states.params  # round-start global model
+        trains = jnp.ones((n_slots,), bool)
+        mix_dt = exchange_dtype or jnp.float32
+
+        # FedAvg weights over ALL C x n_slots sampled clients,
+        # normalized once — the per-step dots then just accumulate
+        w = c_sizes.astype(jnp.float32) * c_alive  # [C, n_slots]
+        denom = jnp.maximum(jnp.sum(w), 1e-9)
+        wn = w / denom
+        got_any = jnp.sum(w) > 0
+
+        acc0 = jax.tree.map(
+            lambda p: jnp.zeros(
+                (p.shape[0], int(np.prod(p.shape[1:], dtype=np.int64))),
+                jnp.float32,
+            ),
+            params0,
+        )
+        carry0 = (fed.states.opt_state, fed.states.rng, fed.states.step,
+                  acc0)
+
+        def body(carry, inputs):
+            opt_state, rng, step, acc = carry
+            x_t, y_t, m_t, alive_t, wn_t = inputs
+            states_t = TrainState(
+                params=params0, opt_state=opt_state, rng=rng, step=step
+            )
+            states_t, tm = _train_and_select(
+                fns, states_t, alive_t, trains, x_t, y_t, m_t, epochs
+            )
+
+            def leaf_acc(a, p):
+                flat = p.reshape(p.shape[0], -1).astype(mix_dt)
+                w_t = jnp.broadcast_to(
+                    wn_t[None, :], (n_slots, n_slots)
+                )
+                return a + jax.lax.dot(
+                    w_t.astype(mix_dt), flat,
+                    preferred_element_type=jnp.float32,
+                )
+
+            acc = jax.tree.map(leaf_acc, acc, states_t.params)
+            carry = (states_t.opt_state, states_t.rng, states_t.step,
+                     acc)
+            return carry, tm["loss"]
+
+        (opt_state, rng, step, acc), losses = jax.lax.scan(
+            body, carry0, (cx, cy, cmask, c_alive, wn)
+        )
+
+        # an empty round (every sampled client dead) keeps the global
+        # model — the cross-device analog of the dense got_any keep
+        keep = jnp.logical_and(fed.alive, got_any)
+
+        def leaf_out(a, p):
+            out = a.reshape(p.shape).astype(p.dtype)
+            c = keep.reshape((n_slots,) + (1,) * (p.ndim - 1))
+            return jnp.where(c, out, p)
+
+        params = jax.tree.map(leaf_out, acc, params0)
+        fed = FederatedState(
+            states=TrainState(
+                params=params, opt_state=opt_state, rng=rng, step=step
+            ),
+            alive=fed.alive,
+            round=fed.round + 1,
+            stale=fed.stale,
+        )
+        metrics = {
+            "train_loss": losses,  # [C, n_slots] per-cohort-step
+            "alive": fed.alive,
+        }
+        return fed, metrics
+
+    return round_fn
+
+
 def build_eval_fn(fns: StepFns) -> Callable:
     """Evaluate every node's model on the (replicated) test set.
 

@@ -46,6 +46,7 @@ HEADLINE: dict[str, str] = {
     "vit32_krum_round_s": "lower",
     "cifar16_dirichlet_round_s": "lower",
     "cpu8_ring_dense_round_s": "lower",
+    "crossdev_round_s_10k": "lower",
 }
 DEFAULT_TOL = 0.15
 

@@ -1,0 +1,361 @@
+"""Cross-device regime (round 13): K-of-N sampling, lazy partitions,
+cohort-scan rounds.
+
+The load-bearing gate is the parity test: the cohort-scan round at
+cohort_size=1 with every client sampled must equal the existing dense
+stacked round BIT-FOR-BIT (tolerance 0) — same training selection,
+same FedAvg weights, same dot shape and reduction order. Everything
+else (sampler determinism, fault composition, lazy partition law) is
+host-side plumbing guarded here at unit scale.
+"""
+
+import json
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from p2pfl_tpu.config.schema import (
+    CrossDeviceConfig,
+    ModelConfig,
+    ScenarioConfig,
+)
+from p2pfl_tpu.datasets.partition import (
+    ClientPartition,
+    dirichlet_partition,
+    lazy_partition_indices,
+)
+from p2pfl_tpu.federation.sampling import sample_clients
+
+
+def _mk_fns():
+    from p2pfl_tpu.learning.learner import make_step_fns
+    from p2pfl_tpu.models.base import build_model
+
+    return make_step_fns(build_model(ModelConfig(model="mlp")),
+                         batch_size=8)
+
+
+# --------------------------------------------------------------------
+# parity: cohort scan == dense stacked round, tolerance 0
+# --------------------------------------------------------------------
+
+def test_cohort_scan_parity_with_dense_round_bit_for_bit():
+    """cohort_size=1, all N clients sampled, fully-connected mix: the
+    cohort-scan program and the dense stacked round must agree on every
+    param (and optimizer-state) leaf with tolerance 0, over multiple
+    rounds — the ISSUE 10 acceptance gate."""
+    from p2pfl_tpu.parallel.federated import (
+        build_round_fn,
+        build_round_fn_cross_device,
+        init_federation,
+    )
+
+    n, s = 8, 16
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(n, s, 28, 28, 1)).astype(np.float32)
+    y = rng.integers(0, 10, size=(n, s)).astype(np.int32)
+    mask = np.ones((n, s), bool)
+    sizes = np.full((n,), s, np.int32)
+
+    fns = _mk_fns()
+    dense = jax.jit(build_round_fn(fns, epochs=1))
+    cross = jax.jit(build_round_fn_cross_device(fns, epochs=1))
+
+    fed_d = init_federation(fns, jnp.asarray(x[0, :1]), n, seed=7)
+    fed_c = init_federation(fns, jnp.asarray(x[0, :1]), n, seed=7)
+
+    mix = np.ones((n, n), np.float32)
+    adopt = np.arange(n, dtype=np.int32)
+    trains = np.ones((n,), bool)
+
+    for r in range(3):
+        fed_d, _ = dense(fed_d, x, y, mask, sizes, mix, adopt, trains)
+        fed_c, _ = cross(fed_c, x[None], y[None], mask[None],
+                         sizes[None], np.ones((1, n), bool))
+        for a, b in zip(jax.tree.leaves(fed_d.states.params),
+                        jax.tree.leaves(fed_c.states.params)):
+            assert np.array_equal(np.asarray(a), np.asarray(b)), (
+                f"param leaf diverged at round {r}"
+            )
+        for a, b in zip(jax.tree.leaves(fed_d.states.opt_state),
+                        jax.tree.leaves(fed_c.states.opt_state)):
+            assert np.array_equal(np.asarray(a), np.asarray(b)), (
+                f"opt leaf diverged at round {r}"
+            )
+
+
+def test_cohort_scan_dead_client_zero_weight():
+    """A dead cohort member neither trains nor contributes weight: the
+    round with the member dead must equal the round where that member's
+    weight is zeroed out entirely (its data rows are inert)."""
+    from p2pfl_tpu.parallel.federated import (
+        build_round_fn_cross_device,
+        init_federation,
+    )
+
+    n, s, c = 4, 8, 2
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(c, n, s, 28, 28, 1)).astype(np.float32)
+    y = rng.integers(0, 10, size=(c, n, s)).astype(np.int32)
+    mask = np.ones((c, n, s), bool)
+    sizes = np.full((c, n), s, np.int32)
+
+    fns = _mk_fns()
+    cross = jax.jit(build_round_fn_cross_device(fns, epochs=1))
+    fed_a = init_federation(fns, jnp.asarray(x[0, 0, :1]), n, seed=3)
+    fed_b = init_federation(fns, jnp.asarray(x[0, 0, :1]), n, seed=3)
+
+    alive = np.ones((c, n), bool)
+    alive[1, 2] = False  # cohort step 1, slot 2 is a dead client
+    fed_a, _ = cross(fed_a, x, y, mask, sizes, alive)
+
+    # arm b: same data but the dead member's size forced to 0 AND its
+    # shard replaced by garbage — must not matter
+    sizes_b = sizes.copy()
+    sizes_b[1, 2] = 0
+    x_b = x.copy()
+    x_b[1, 2] = 999.0
+    fed_b, _ = cross(fed_b, x_b, y, mask, sizes_b, alive)
+    for a, b in zip(jax.tree.leaves(fed_a.states.params),
+                    jax.tree.leaves(fed_b.states.params)):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+# --------------------------------------------------------------------
+# sampler: determinism, no replacement, weighting
+# --------------------------------------------------------------------
+
+def test_sample_clients_deterministic_across_processes():
+    """The (seed, round) key fully determines the draw — a separate
+    interpreter must reproduce it exactly (restart/multi-process
+    agreement without coordination)."""
+    here = sample_clients(1000, 64, round_num=5, seed=42)
+    code = (
+        "import json\n"
+        f"import sys; sys.path.insert(0, {str((__import__('pathlib').Path(__file__).resolve().parent.parent))!r})\n"
+        "from p2pfl_tpu.federation.sampling import sample_clients\n"
+        "print(json.dumps(sample_clients(1000, 64, round_num=5, "
+        "seed=42).tolist()))\n"
+    )
+    res = subprocess.run([sys.executable, "-c", code],
+                         capture_output=True, text=True, timeout=60)
+    assert res.returncode == 0, res.stderr[-500:]
+    there = json.loads(res.stdout.strip().splitlines()[-1])
+    assert here.tolist() == there
+
+
+def test_sample_clients_no_replacement_and_round_variation():
+    for r in range(5):
+        s = sample_clients(100, 60, round_num=r, seed=0)
+        assert len(np.unique(s)) == 60  # no repeats within a round
+        assert s.min() >= 0 and s.max() < 100
+    a = sample_clients(100, 60, round_num=0, seed=0)
+    b = sample_clients(100, 60, round_num=1, seed=0)
+    assert not np.array_equal(a, b)  # rounds draw differently
+    assert np.array_equal(a, sample_clients(100, 60, 0, seed=0))
+
+
+def test_sample_clients_weighted_proportions():
+    """Data-size weighting: over many rounds, a client with 4x the
+    weight is drawn ~4x as often; zero-weight clients never appear."""
+    n, k = 40, 8
+    weights = np.ones(n)
+    weights[0] = 0.0  # never drawn
+    heavy = np.arange(1, 9)
+    weights[heavy] = 4.0
+    counts = np.zeros(n)
+    rounds = 400
+    for r in range(rounds):
+        s = sample_clients(n, k, round_num=r, seed=9, weights=weights)
+        counts[s] += 1
+    assert counts[0] == 0
+    light = np.setdiff1d(np.arange(1, n), heavy)
+    ratio = counts[heavy].mean() / counts[light].mean()
+    assert 2.5 < ratio < 6.0, ratio  # ~4x with sampling noise
+
+
+def test_sample_clients_fail_loud():
+    with pytest.raises(ValueError, match="cannot sample"):
+        sample_clients(4, 5, round_num=0)
+    with pytest.raises(ValueError, match="positive"):
+        sample_clients(4, 3, 0, weights=np.array([1.0, 1.0, 0.0, 0.0]))
+    with pytest.raises(ValueError, match="shape"):
+        sample_clients(4, 2, 0, weights=np.ones(3))
+
+
+# --------------------------------------------------------------------
+# fault composition: sampled-but-dead drops from the cohort
+# --------------------------------------------------------------------
+
+def test_dead_client_drops_from_cohort_via_fault_event():
+    """A FaultEvent crash on the virtual clock: the client is still
+    SAMPLED (the draw stays reproducible from (seed, round) alone) but
+    rides the cohort with alive=False — zero training gate, zero
+    FedAvg weight."""
+    from p2pfl_tpu.federation.scenario import CrossDeviceScenario
+
+    cfg = ScenarioConfig.from_dict({
+        "name": "crossdev-fault", "n_nodes": 4,
+        "model": {"model": "mlp"},
+        "data": {"dataset": "mnist", "synthetic_train": 1024,
+                 "synthetic_test": 128, "batch_size": 16},
+        "training": {"rounds": 2, "eval_every": 0},
+        # eviction within the faulted round: one heartbeat period
+        # advances past node_timeout_s of silence
+        "protocol": {"heartbeat_period_s": 1.0, "node_timeout_s": 0.5},
+        "cross_device": {"n_clients": 16, "clients_per_round": 16,
+                         "cohort_size": 4, "seed": 1},
+        "faults": [{"round": 0, "node": 3, "kind": "crash"},
+                   {"round": 1, "node": 3, "kind": "recover"}],
+    })
+    sc = CrossDeviceScenario(cfg)
+    res = sc.run(rounds=1)
+    # K == N: every client (incl. the dead one) is in the round
+    assert sorted(sc.last_sampled.tolist()) == list(range(16))
+    dead_pos = sc.last_cohorts == 3
+    assert dead_pos.sum() == 1
+    assert not sc.last_cohort_alive[dead_pos].any()
+    assert sc.last_cohort_alive[~dead_pos].all()
+    # recover fault: next round the client rides alive again
+    sc.run(rounds=1)
+    assert sc.last_cohort_alive.all()
+    assert res.rounds_run == 1
+    sc.close()
+
+
+# --------------------------------------------------------------------
+# config plumbing
+# --------------------------------------------------------------------
+
+def test_cross_device_config_validation():
+    cd = CrossDeviceConfig(n_clients=1000, clients_per_round=64,
+                           cohort_size=8)
+    assert cd.active and cd.n_slots == 8
+    assert not CrossDeviceConfig().active
+    with pytest.raises(ValueError, match="cohort_size"):
+        CrossDeviceConfig(n_clients=100, clients_per_round=10,
+                          cohort_size=3)
+    with pytest.raises(ValueError, match="sampling"):
+        CrossDeviceConfig(n_clients=100, clients_per_round=10,
+                          cohort_size=5, sampling="magic")
+    with pytest.raises(ValueError, match="clients_per_round"):
+        CrossDeviceConfig(n_clients=10, clients_per_round=20,
+                          cohort_size=2)
+
+
+def test_scenario_classes_fail_loud_on_wrong_regime():
+    from p2pfl_tpu.federation.scenario import (
+        CrossDeviceScenario,
+        Scenario,
+    )
+
+    cd_cfg = ScenarioConfig.from_dict({
+        "name": "x", "n_nodes": 4,
+        "cross_device": {"n_clients": 64, "clients_per_round": 8,
+                         "cohort_size": 2},
+    })
+    with pytest.raises(ValueError, match="CrossDeviceScenario"):
+        Scenario(cd_cfg)
+    with pytest.raises(ValueError, match="n_clients"):
+        CrossDeviceScenario(ScenarioConfig(name="y", n_nodes=4))
+
+
+# --------------------------------------------------------------------
+# lazy partitions + cross-device data
+# --------------------------------------------------------------------
+
+def test_lazy_partition_iid_coverage_disjoint():
+    labels = np.random.default_rng(0).integers(0, 10, 1000)
+    part = lazy_partition_indices(labels, 50, scheme="iid", seed=3)
+    assert isinstance(part, ClientPartition)
+    assert part.n_clients == 50
+    assert (part.sizes() == 20).all()
+    seen = np.concatenate([part.client_indices(i) for i in range(50)])
+    assert len(np.unique(seen)) == len(seen)  # disjoint
+    # deterministic in seed
+    again = lazy_partition_indices(labels, 50, scheme="iid", seed=3)
+    assert np.array_equal(part.order, again.order)
+
+
+def test_lazy_partition_dirichlet_large_n():
+    """The vectorized assignment path at cross-device width: full
+    coverage, disjoint shards, min_per_client respected, seeded."""
+    labels = np.random.default_rng(1).integers(0, 10, 8000)
+    part = lazy_partition_indices(labels, 600, scheme="dirichlet",
+                                  seed=5, alpha=0.5)
+    assert part.n_clients == 600
+    assert part.sizes().min() >= 1
+    assert part.sizes().sum() == 8000
+    all_idx = np.sort(part.order)
+    assert np.array_equal(all_idx, np.arange(8000))
+    again = lazy_partition_indices(labels, 600, scheme="dirichlet",
+                                   seed=5, alpha=0.5)
+    assert np.array_equal(part.order, again.order)
+    assert np.array_equal(part.offsets, again.offsets)
+
+
+def test_lazy_partition_dirichlet_sparse_regime_repairs():
+    """10k clients on a 60k-sample dataset (the README quickstart
+    shape): ~6 samples/client means no redraw can ever give every node
+    the floor — the vectorized path must repair the draw instead of
+    exhausting its budget, and still raise when the floor is
+    arithmetically infeasible."""
+    labels = np.random.default_rng(3).integers(0, 10, 60_000)
+    part = lazy_partition_indices(labels, 10_000, scheme="dirichlet",
+                                  seed=0, alpha=0.5)
+    sizes = part.sizes()
+    assert sizes.min() >= 1
+    assert sizes.sum() == 60_000
+    assert np.array_equal(np.sort(part.order), np.arange(60_000))
+    again = lazy_partition_indices(labels, 10_000, scheme="dirichlet",
+                                   seed=0, alpha=0.5)
+    assert np.array_equal(part.order, again.order)
+    # Repair moves only surplus: the distribution stays non-IID.
+    assert sizes.max() > 3 * sizes.mean()
+    with pytest.raises(RuntimeError, match="at least"):
+        lazy_partition_indices(labels[:4000], 10_000, scheme="dirichlet",
+                               seed=0, alpha=0.5)
+
+
+def test_dirichlet_partition_vectorized_path_matches_law():
+    """n_nodes >= 512 takes the vectorized path: every node covered,
+    every sample assigned exactly once, deterministic in seed. (The
+    small-N path keeps the legacy draw order byte-for-byte — its
+    outputs are pinned by the existing dataset tests.)"""
+    labels = np.random.default_rng(2).integers(0, 10, 6000)
+    parts = dirichlet_partition(labels, 512, alpha=0.5, seed=11)
+    assert len(parts) == 512
+    assert min(len(p) for p in parts) >= 2
+    seen = np.sort(np.concatenate(parts))
+    assert np.array_equal(seen, np.arange(6000))
+    again = dirichlet_partition(labels, 512, alpha=0.5, seed=11)
+    for a, b in zip(parts, again):
+        assert np.array_equal(a, b)
+
+
+def test_cross_device_data_cohort_batch_shapes_and_determinism():
+    from p2pfl_tpu.config.schema import DataConfig
+    from p2pfl_tpu.datasets.data import CrossDeviceData
+
+    data = CrossDeviceData.make(
+        DataConfig(dataset="mnist", synthetic_train=2048,
+                   synthetic_test=128, samples_per_node=16),
+        n_clients=64,
+    )
+    assert data.n_clients == 64
+    assert data.shard_size == 16
+    ids = np.array([3, 17, 3, 60])
+    x, y, mask, sizes = data.cohort_batch(ids)
+    assert x.shape == (4, 16) + data.input_shape
+    assert y.shape == mask.shape == (4, 16)
+    assert sizes.shape == (4,)
+    assert (sizes <= 16).all() and (sizes > 0).all()
+    assert (mask.sum(axis=1) == sizes).all()
+    # same client id materializes identically (seeded shuffle)
+    assert np.array_equal(x[0], x[2]) and np.array_equal(y[0], y[2])
+    # client_sizes caps at the fixed shard size
+    assert (data.client_sizes <= data.shard_size).all()

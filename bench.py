@@ -1017,6 +1017,16 @@ _CROSSDEV_KEYS = (
     "crossdev_final_acc",
 )
 
+# keys the chaos phase (round 14: partition + crash + restart under a
+# scripted schedule) emits; static so BENCH_KEYS and the
+# P2PFL_CHAOS_DRY plan stay authoritative
+_CHAOS_KEYS = (
+    "chaos_recovery_s", "chaos_final_accuracy",
+    "chaos_clean_accuracy", "chaos_accuracy_gap",
+    "chaos_rounds", "chaos_wall_s", "chaos_clean_wall_s",
+    "chaos_partitions", "chaos_restarted",
+)
+
 # Authoritative registry of every top-level key bench can emit.
 # scripts/check_bench_keys.py asserts each one is documented in
 # docs/perf.md (§10 key reference) and that no emission site uses a
@@ -1064,6 +1074,8 @@ BENCH_KEYS = (
     "obs_health_dry", "obs_health_keys", *_HEALTH_KEYS,
     # cross_device (round 13: K-of-N sampling + cohort-scan rounds)
     "crossdev_dry", "crossdev_keys", *_CROSSDEV_KEYS,
+    # chaos (round 14: partition-tolerance + crash-consistent restart)
+    "chaos_dry", "chaos_keys", *_CHAOS_KEYS,
     # run-metadata stamp (round 12 regression gate provenance)
     "meta",
     # orchestration-test hook
@@ -2035,6 +2047,106 @@ def _phase_cross_device() -> None:
               file=sys.stderr, flush=True)
 
 
+def _phase_chaos() -> None:
+    """Chaos scheduler (round 14: partition tolerance + crash-
+    consistent restart): a 16-node socket federation under a scripted
+    split-brain — partition into two 8-node halves for 2 rounds, one
+    node crashed during the cut and relaunched through the
+    checkpoint-resume path after the heal — measured against its
+    fault-free twin (same config, no faults, interleave-free: the two
+    runs share one CPU subprocess sequentially).
+
+    Headline keys: ``chaos_recovery_s`` (heal observation → every live
+    node past its at-heal round, i.e. the first post-merge round) and
+    ``chaos_final_accuracy`` (vs ``chaos_clean_accuracy``; the gap is
+    the price of the outage, acceptance wants it within 5%).
+
+    ``P2PFL_CHAOS_DRY=1`` emits the key plan without touching the
+    accelerator — the orchestration test's smoke hook."""
+    if os.environ.get("P2PFL_CHAOS_DRY") == "1":
+        _part({"chaos_dry": True, "chaos_keys": list(_CHAOS_KEYS)})
+        return
+
+    import json as _json
+    import subprocess
+
+    code = r"""
+import os, re, json, tempfile
+flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+               os.environ.get("XLA_FLAGS", "")).strip()
+os.environ["XLA_FLAGS"] = flags
+import jax
+jax.config.update("jax_platforms", "cpu")
+import sys; sys.path.insert(0, %r)
+from p2pfl_tpu.config.schema import (ScenarioConfig, TrainingConfig,
+    ProtocolConfig, DataConfig, ElasticConfig, FaultEvent)
+from p2pfl_tpu.p2p.launch import run_simulation
+
+def cfg(faults, ckpt_dir):
+    return ScenarioConfig(
+        name="chaos16", n_nodes=16, topology="fully",
+        data=DataConfig(dataset="mnist", samples_per_node=60),
+        training=TrainingConfig(rounds=6, epochs_per_round=1,
+                                learning_rate=0.05),
+        protocol=ProtocolConfig(heartbeat_period_s=0.5,
+                                aggregation_timeout_s=12.0,
+                                vote_timeout_s=5.0, node_timeout_s=3.0,
+                                train_set_size=16, gossip_fanout=8),
+        # async close rule: each side of the split must keep closing
+        # rounds at quorum while the other half is unreachable
+        elastic=ElasticConfig(async_aggregation=True, min_received=0.4,
+                              staleness_beta=0.5,
+                              heartbeat_backoff_base_s=0.25),
+        faults=faults,
+        checkpoint_dir=ckpt_dir, checkpoint_every=1,
+    )
+
+halves = [[0, 1, 2, 3, 4, 5, 6, 7], [8, 9, 10, 11, 12, 13, 14, 15]]
+with tempfile.TemporaryDirectory() as d:
+    clean = run_simulation(cfg([], d + "/clean"), timeout=300)
+    faults = [
+        FaultEvent(node=0, round=2, kind="partition", groups=halves),
+        FaultEvent(node=11, round=2, kind="crash"),
+        FaultEvent(node=0, round=4, kind="heal"),
+        FaultEvent(node=11, round=4, kind="restart"),
+    ]
+    chaos = run_simulation(cfg(faults, d + "/chaos"), timeout=300)
+print("BENCH_CHAOS " + json.dumps({"clean": clean, "chaos": chaos}),
+      flush=True)
+""" % (_REPO,)
+    try:
+        res = subprocess.run([sys.executable, "-c", code],
+                             capture_output=True, text=True, timeout=700)
+        got = None
+        for line in res.stdout.splitlines():
+            if line.startswith("BENCH_CHAOS "):
+                got = _json.loads(line[len("BENCH_CHAOS "):])
+        if not got:
+            print(f"chaos child rc={res.returncode}: "
+                  f"{res.stderr[-400:]}", file=sys.stderr, flush=True)
+            return
+        clean, chaos = got.get("clean") or {}, got.get("chaos") or {}
+        churn = chaos.get("churn") or {}
+        part = {
+            "chaos_recovery_s": churn.get("recovery_s"),
+            "chaos_final_accuracy": chaos.get("mean_accuracy"),
+            "chaos_clean_accuracy": clean.get("mean_accuracy"),
+            "chaos_rounds": chaos.get("rounds"),
+            "chaos_wall_s": chaos.get("wall_s"),
+            "chaos_clean_wall_s": clean.get("wall_s"),
+            "chaos_partitions": churn.get("partitions"),
+            "chaos_restarted": churn.get("restarted"),
+        }
+        if (clean.get("mean_accuracy") is not None
+                and chaos.get("mean_accuracy") is not None):
+            part["chaos_accuracy_gap"] = round(
+                clean["mean_accuracy"] - chaos["mean_accuracy"], 4)
+        _part(part)
+    except Exception as e:
+        print(f"chaos phase failed: {e!r}"[:300], file=sys.stderr,
+              flush=True)
+
+
 def _run_meta() -> dict:
     """Provenance stamp for every BENCH json — what
     scripts/check_bench_regress.py prints next to its verdict, so a
@@ -2206,6 +2318,7 @@ def main() -> None:
         ("robust", "_phase_robust", 150),
         ("elastic", "_phase_elastic", 150),
         ("cross_device", "_phase_cross_device", 120),
+        ("chaos", "_phase_chaos", 120),
         ("vit32", "_phase_vit32", 120),
     ]
     for name, fn, min_s in phases:

@@ -118,11 +118,50 @@ class ProtocolConfig:
 
 
 @dataclasses.dataclass
+class PartitionSpec:
+    """One scheduled network-partition window on the emulated links
+    (round 14). While the window is open, every message whose source
+    and destination sit in DIFFERENT ``groups`` entries is dropped on
+    the floor — a clean bisection, composing with whatever delay/loss/
+    rate shaping the link already carries. Nodes absent from every
+    group are unaffected. Times are seconds of shaper wall time
+    (measured from shaper creation, i.e. federation start); the
+    optional ``jitter_s`` perturbs both boundaries with a draw seeded
+    from ``(NetworkConfig.seed, "partition", window index)`` — the SAME
+    draw on every node, so the cut stays symmetric."""
+
+    start_s: float = 0.0
+    duration_s: float = 0.0
+    groups: list[list[int]] = dataclasses.field(default_factory=list)
+    jitter_s: float = 0.0
+
+    def __post_init__(self):
+        if self.duration_s < 0 or self.start_s < 0 or self.jitter_s < 0:
+            raise ValueError("partition times must be non-negative")
+        if len(self.groups) < 2:
+            raise ValueError(
+                "a partition needs >= 2 groups to sever anything"
+            )
+        seen: set[int] = set()
+        for g in self.groups:
+            for n in g:
+                if n in seen:
+                    raise ValueError(
+                        f"node {n} appears in two partition groups"
+                    )
+                seen.add(n)
+
+
+@dataclasses.dataclass
 class NetworkConfig:
     """Deterministic per-link network emulation on the socket path —
     the tcset --delay/--loss analog (fedstellar/base_node.py:82-85,
     participant.json.example:34-38), applied in-process and seeded so
     a lossy-network test replays identically. All-zero = no shaping.
+
+    ``partitions`` (round 14) scripts sever/heal windows on top of the
+    shaping: see :class:`PartitionSpec`. A config whose only non-zero
+    content is a partition plan still activates the shaper.
     """
 
     delay_ms: float = 0.0
@@ -130,6 +169,16 @@ class NetworkConfig:
     loss_pct: float = 0.0
     rate_mbps: float = 0.0  # link bandwidth; 0 = unlimited
     seed: int = 0
+    partitions: list[PartitionSpec] = dataclasses.field(
+        default_factory=list)
+
+    def __post_init__(self):
+        # from_dict hydrates NetworkConfig via cls(**d); nested
+        # partition windows arrive as plain dicts
+        self.partitions = [
+            p if isinstance(p, PartitionSpec) else PartitionSpec(**p)
+            for p in self.partitions
+        ]
 
 
 @dataclasses.dataclass
@@ -186,18 +235,30 @@ class FaultEvent:
     ``join`` is ``recover`` plus state transfer: the node re-enters
     through the live join handshake (CONNECT hello + checkpoint-format
     model fetch) instead of resuming with whatever params it died with.
+
+    Round 14 adds the partition-tolerance kinds: ``partition`` severs
+    every link crossing the ``groups`` cut (``node`` is unused),
+    ``heal`` reconnects all severed links and triggers eviction
+    amnesty, and ``restart`` relaunches a previously crashed node
+    through the crash-consistent resume path (newest of its own
+    checkpoint vs a peer's STATE_SYNC) instead of the fresh join.
     """
 
     node: int = 0
     round: int = 0
-    kind: str = "crash"  # crash | recover | join
+    kind: str = "crash"  # crash | recover | join | partition | heal | restart
+    # partition only: the cut, as disjoint node groups (see PartitionSpec)
+    groups: list[list[int]] = dataclasses.field(default_factory=list)
 
     def __post_init__(self):
-        known = ("crash", "recover", "join")
+        known = ("crash", "recover", "join", "partition", "heal",
+                 "restart")
         if self.kind not in known:
             raise ValueError(
                 f"unknown fault kind {self.kind!r}; have {known}"
             )
+        if self.kind == "partition" and len(self.groups) < 2:
+            raise ValueError("a partition fault needs >= 2 groups")
 
 
 @dataclasses.dataclass

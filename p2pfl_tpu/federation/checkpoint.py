@@ -28,6 +28,50 @@ def checkpoint_path(directory: str | pathlib.Path, round_num: int) -> pathlib.Pa
     return pathlib.Path(directory) / f"round_{round_num:05d}{_SUFFIX}"
 
 
+def node_checkpoint_path(directory: str | pathlib.Path,
+                         node_idx: int) -> pathlib.Path:
+    """A socket node's private periodic checkpoint (round 14). One
+    file per node, atomically replaced each save — the newest state
+    always wins and the directory never grows with the run."""
+    return pathlib.Path(directory) / f"node_{node_idx:03d}{_SUFFIX}"
+
+
+def _atomic_write_bytes(path: pathlib.Path, blob: bytes) -> None:
+    """Crash-consistent publish: tmp + flush + fsync + ``os.replace``,
+    then fsync the directory so the rename itself survives a power
+    cut. A reader can observe the old file or the new file, never a
+    torn one."""
+    path.parent.mkdir(parents=True, exist_ok=True)
+    tmp = path.with_suffix(path.suffix + ".tmp")
+    with open(tmp, "wb") as f:
+        f.write(blob)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    try:
+        dir_fd = os.open(path.parent, os.O_RDONLY)
+    except OSError:
+        return  # platform without directory fds: rename is best-effort
+    try:
+        os.fsync(dir_fd)
+    finally:
+        os.close(dir_fd)
+
+
+def _restore_blob(path: str | pathlib.Path) -> Any:
+    """msgpack_restore with torn-file detection: a truncated or
+    corrupt checkpoint fails loudly NAMING THE FILE instead of leaking
+    a bare msgpack unpack error from deep inside flax."""
+    blob = pathlib.Path(path).read_bytes()
+    try:
+        return flax_ser.msgpack_restore(blob)
+    except Exception as e:
+        raise ValueError(
+            f"checkpoint {path} is truncated or corrupt "
+            f"({len(blob)} bytes): {e!r}"
+        ) from e
+
+
 # ---- wire transfer (round 11: live join handshake) ---------------------
 
 def pack_model(params: Any, round_num: int) -> bytes:
@@ -47,7 +91,10 @@ def unpack_model(blob: bytes, template: Any) -> tuple[Any, int]:
     ``template``; returns ``(params, round)``. Leaves are copied
     (non-owning msgpack views must never back donated buffers — see
     ``load_checkpoint``) and dtype-conformed to the template."""
-    obj = flax_ser.msgpack_restore(blob)
+    return _model_from_obj(flax_ser.msgpack_restore(blob), template)
+
+
+def _model_from_obj(obj: Any, template: Any) -> tuple[Any, int]:
     try:
         restored = flax_ser.from_state_dict(template, obj["params"])
     except Exception as e:
@@ -92,9 +139,7 @@ def save_checkpoint(directory: str | pathlib.Path, fed: FederatedState) -> pathl
         blob = flax_ser.msgpack_serialize(flax_ser.to_state_dict(host))
         # atomic publish: a crash mid-write must never leave a truncated
         # round_NNNNN file for latest_checkpoint to pick up
-        tmp = path.with_suffix(path.suffix + ".tmp")
-        tmp.write_bytes(blob)
-        os.replace(tmp, path)
+        _atomic_write_bytes(path, blob)
     if multi:
         from jax.experimental import multihost_utils
 
@@ -102,6 +147,37 @@ def save_checkpoint(directory: str | pathlib.Path, fed: FederatedState) -> pathl
     flight.record("checkpoint.save", round=int(host.round),
                   path=str(path))
     return path
+
+
+def save_node_checkpoint(directory: str | pathlib.Path, node_idx: int,
+                         params: Any, round_num: int) -> pathlib.Path:
+    """Periodic per-node atomic checkpoint (round 14, socket plane):
+    the node's current params + round in the SAME msgpack format the
+    STATE_SYNC join handshake ships (``pack_model``), so a relaunched
+    node can adopt whichever of (own disk state, peer sync) is newer
+    without a second deserializer."""
+    path = node_checkpoint_path(directory, node_idx)
+    _atomic_write_bytes(path, pack_model(params, round_num))
+    flight.record("checkpoint.node_save", node=int(node_idx),
+                  round=int(round_num), path=str(path))
+    return path
+
+
+def load_node_checkpoint(directory: str | pathlib.Path, node_idx: int,
+                         template: Any) -> tuple[Any, int] | None:
+    """Restore a node's private checkpoint; ``None`` when the node has
+    never saved one. A torn/corrupt file raises ValueError naming the
+    file (``_restore_blob``)."""
+    path = node_checkpoint_path(directory, node_idx)
+    if not path.is_file():
+        return None
+    obj = _restore_blob(path)
+    flight.record("checkpoint.node_load", node=int(node_idx),
+                  path=str(path))
+    try:
+        return _model_from_obj(obj, template)
+    except ValueError as e:
+        raise ValueError(f"checkpoint {path}: {e}") from e
 
 
 def all_checkpoints(directory: str | pathlib.Path) -> list[pathlib.Path]:
@@ -120,7 +196,7 @@ def latest_checkpoint(directory: str | pathlib.Path) -> pathlib.Path | None:
 def load_checkpoint(path: str | pathlib.Path, template: FederatedState) -> FederatedState:
     """Restore into the structure of ``template`` (shape/dtype checked
     by flax's from_bytes-style restore against the template leaves)."""
-    obj = flax_ser.msgpack_restore(pathlib.Path(path).read_bytes())
+    obj = _restore_blob(path)
     flight.record("checkpoint.load", path=str(path))
     try:
         restored = flax_ser.from_state_dict(template, obj)

@@ -28,6 +28,14 @@ class Events(enum.Enum):
     LEARNING_FINISHED = "learning_finished"
     METRICS_REPORTED = "metrics_reported"  # REPORT_STATUS analog
     CHECKPOINT_SAVED = "checkpoint_saved"
+    # round 14 partition tolerance: a scripted (or netem-scheduled)
+    # partition severed the link sets between cohort groups / healed
+    # them again; heal is the amnesty trigger for sticky evictions
+    LINK_PARTITIONED = "link_partitioned"
+    LINK_HEALED = "link_healed"
+    # round 14 crash consistency: a node came back through the
+    # checkpoint-resume path (vs NODE_JOINED's fresh STATE_SYNC join)
+    NODE_RESTARTED = "node_restarted"
 
 
 class Observer:

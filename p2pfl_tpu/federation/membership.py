@@ -77,9 +77,11 @@ class Membership(Observable):
     def apply_fault(self, fault: FaultEvent) -> None:
         if fault.kind == "crash":
             self.beating[fault.node] = False
-        elif fault.kind in ("recover", "join"):
+        elif fault.kind in ("recover", "join", "restart"):
             # "join" is recover at this layer; the state transfer
-            # (checkpoint-format model fetch) is the caller's job
+            # (checkpoint-format model fetch) is the caller's job.
+            # "restart" is the same except the caller resumes from the
+            # node's own checkpoint instead of a peer's state
             self.departed[fault.node] = False
             self.beating[fault.node] = True
             self.beat(fault.node)
@@ -88,8 +90,45 @@ class Membership(Observable):
                               t=self.clock)
                 self.notify(Events.NODE_JOINED,
                             {"node": fault.node, "t": self.clock})
+            elif fault.kind == "restart":
+                flight.record("membership.restart", node=fault.node,
+                              t=self.clock)
+                self.notify(Events.NODE_RESTARTED,
+                            {"node": fault.node, "t": self.clock})
+        elif fault.kind == "partition":
+            # the cut itself lives in the transport (netem / node
+            # sever sets); membership only records + fans out the event
+            flight.record("membership.partition", groups=fault.groups,
+                          t=self.clock)
+            self.notify(Events.LINK_PARTITIONED,
+                        {"groups": fault.groups, "t": self.clock})
+        elif fault.kind == "heal":
+            # the heal observation IS the amnesty trigger: every sticky
+            # departure re-enters the probe machine (satellite: the
+            # round-11 dead end where a healed partition's peers stayed
+            # departed forever once retry_limit was exhausted)
+            for node in np.flatnonzero(self.departed):
+                self.amnesty(int(node))
+            flight.record("membership.heal", t=self.clock)
+            self.notify(Events.LINK_HEALED, {"t": self.clock})
         else:
             raise ValueError(f"unknown fault kind {fault.kind!r}")
+
+    def amnesty(self, node: int, t: float | None = None) -> None:
+        """Clear a sticky departure on a heal observation — keyed on
+        the HEAL, not on the retry budget: the budget stays exhausted
+        until this runs, which is exactly the round-11 dead end. The
+        node is NOT declared alive; it re-enters as a suspect with a
+        fresh probe budget and an immediately-due probe, so the
+        existing probe machinery (or its next heartbeat) resurrects it
+        if and only if it is actually reachable again."""
+        t = self.clock if t is None else t
+        if not self.departed[node] and self.alive[node]:
+            return  # nothing to forgive
+        self.departed[node] = False
+        self.probe_failures[node] = 0
+        self.next_probe[node] = t
+        flight.record("membership.amnesty", node=node, t=t)
 
     # -- suspect/probe state machine (socket plane) ----------------------
     def probes_due(self, t: float | None = None) -> list[int]:

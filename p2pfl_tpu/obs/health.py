@@ -33,6 +33,13 @@ Built-in rules (severity in parentheses; all thresholds live on
 - ``accuracy-divergence`` (warn): a node's accuracy sits
   ``divergence`` below the cohort median (statuses first, newest
   ``metrics.jsonl`` Test/accuracy rows as fallback).
+- ``partition-suspected`` (crit): the live cohort's per-peer byte
+  counters (``peer_bytes_in``/``peer_bytes_out`` in the status
+  records) split into 2+ disjoint reachability components — traffic
+  keeps flowing INSIDE each side of a cut while every cross-cut
+  counter goes one-sided, which is exactly what the plain per-node
+  totals cannot show. Needs engine state across evaluations (counter
+  deltas); a single snapshot never fires it.
 
 The engine is deliberately read-only and dependency-light: it never
 talks to nodes, only to the filesystem artifacts they already publish,
@@ -221,6 +228,78 @@ def rule_accuracy_divergence(snap: Snapshot,
     ]
 
 
+def _peer_totals(rec: dict) -> dict[int, int] | None:
+    """Combined per-peer wire totals from one status record; None when
+    the record predates the per-link counters. JSON stringifies the
+    peer-index keys — normalize back to ints here."""
+    pin, pout = rec.get("peer_bytes_in"), rec.get("peer_bytes_out")
+    if pin is None and pout is None:
+        return None
+    tot: dict[int, int] = {}
+    for d in (pin or {}, pout or {}):
+        for k, v in d.items():
+            tot[int(k)] = tot.get(int(k), 0) + int(v)
+    return tot
+
+
+def rule_partition_suspected(snap: Snapshot,
+                             eng: "HealthEngine") -> list[dict]:
+    """Disjoint reachability from per-link counter deltas: a link
+    (a, b) is UP when either side moved bytes toward the other since
+    the previous evaluation; a partition is the live cohort splitting
+    into 2+ connected components of that graph. One federation-level
+    finding (node=None) naming the cohorts — the cut is a property of
+    the federation, not of any single node."""
+    cur: dict[int, dict[int, int]] = {}
+    for rec in snap.alive():
+        tot = _peer_totals(rec)
+        if tot is not None:
+            cur[int(rec.get("node", -1))] = tot
+    prev = eng.peer_bytes
+    # only nodes seen in BOTH evaluations can be judged: a first-ever
+    # snapshot has no delta, and a brand-new node's silence toward
+    # everyone would read as an instant (false) singleton cohort
+    nodes = sorted(set(cur) & set(prev))
+    if len(nodes) < snap.cfg.min_cohort:
+        return []
+
+    def grew(a: int, b: int) -> bool:
+        return cur[a].get(b, 0) > prev[a].get(b, 0)
+
+    up: dict[int, set[int]] = {a: set() for a in nodes}
+    for i, a in enumerate(nodes):
+        for b in nodes[i + 1:]:
+            if grew(a, b) or grew(b, a):
+                up[a].add(b)
+                up[b].add(a)
+    if not any(up.values()):
+        # NOTHING moved anywhere — a fully quiescent cohort (finished
+        # run corpse, global stall) is round-stall/node-dead territory,
+        # not a partition: a real cut keeps each side gossiping inside
+        # itself while only the cross-cut counters go one-sided
+        return []
+    comps, seen = [], set()
+    for a in nodes:
+        if a in seen:
+            continue
+        stack, comp = [a], []
+        while stack:
+            x = stack.pop()
+            if x in seen:
+                continue
+            seen.add(x)
+            comp.append(x)
+            stack.extend(up[x] - seen)
+        comps.append(sorted(comp))
+    if len(comps) < 2:
+        return []
+    comps.sort(key=lambda c: (-len(c), c))
+    desc = " | ".join("{" + ",".join(map(str, c)) + "}" for c in comps)
+    return [{"node": None,
+             "message": f"per-peer traffic one-sided across a cohort "
+                        f"cut: {desc}"}]
+
+
 @dataclasses.dataclass(frozen=True)
 class Rule:
     name: str
@@ -236,6 +315,7 @@ def default_rules() -> list[Rule]:
         Rule("byte-rate", "warn", rule_byte_rate),
         Rule("recompile-storm", "warn", rule_recompile_storm),
         Rule("accuracy-divergence", "warn", rule_accuracy_divergence),
+        Rule("partition-suspected", "crit", rule_partition_suspected),
     ]
 
 
@@ -254,6 +334,9 @@ class HealthEngine:
         self.transitions: list[dict[str, Any]] = []
         # node -> (round, ts first seen at that round)
         self.round_progress: dict[int, tuple[int, float]] = {}
+        # node -> per-peer combined wire totals at the previous
+        # evaluation (partition-suspected's delta baseline)
+        self.peer_bytes: dict[int, dict[int, int]] = {}
 
     # -- evaluation -----------------------------------------------------
     def _note_progress(self, snap: Snapshot) -> None:
@@ -264,6 +347,10 @@ class HealthEngine:
             seen = self.round_progress.get(node)
             if seen is None or seen[0] != rnd:
                 self.round_progress[node] = (rnd, snap.now)
+        for rec in snap.statuses:
+            tot = _peer_totals(rec)
+            if tot is not None:
+                self.peer_bytes[int(rec.get("node", -1))] = tot
 
     def evaluate(self, statuses: list[dict[str, Any]],
                  metrics: list[dict[str, Any]] | None = None,

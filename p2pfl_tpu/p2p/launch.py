@@ -116,10 +116,13 @@ def _declares_full_mesh(cfg) -> bool:
     direct link: fully-connected topology with no link shaping at all.
     Any shaping (loss, delay, jitter, or a rate cap that can convoy
     beats behind multi-MB PARAMS frames) disqualifies — relay damping
-    must not remove the repair path on links the shaper degrades."""
+    must not remove the repair path on links the shaper degrades.
+    A scheduled partition plan disqualifies for the same reason: while
+    a cut is open the "full mesh" promise is false by design."""
     net = cfg.network
     return cfg.topology == "fully" and not (
         net.loss_pct or net.delay_ms or net.jitter_ms or net.rate_mbps
+        or getattr(net, "partitions", None)
     )
 
 
@@ -138,13 +141,16 @@ def _free_ports(n: int) -> list[int]:
 async def _run_node(cfg: ScenarioConfig, idx: int, ports: list[int],
                     tls_dir: str | None = None,
                     hosts: list[str] | None = None,
-                    bind: str = "127.0.0.1") -> dict:
+                    bind: str = "127.0.0.1",
+                    resume: bool = False) -> dict:
     """One node's full lifecycle (node_start.py main analog).
 
     ``hosts`` gives each node's reachable address (container service
     names in a compose deployment; defaults to loopback for localhost
     federations); ``bind`` is this node's listen address ("0.0.0.0"
-    inside containers so peers can reach it).
+    inside containers so peers can reach it). ``resume=True`` is the
+    supervisor's restart path: the node adopts its own periodic
+    checkpoint and re-enters through the live-join handshake.
     """
     n = cfg.n_nodes
     hosts = hosts or ["127.0.0.1"] * n
@@ -186,6 +192,10 @@ async def _run_node(cfg: ScenarioConfig, idx: int, ports: list[int],
         elastic=cfg.elastic,
         fit_slowdown=cfg.nodes[idx].fit_slowdown,
         local_epochs=cfg.nodes[idx].epochs,
+        checkpoint_dir=cfg.checkpoint_dir,
+        checkpoint_every=cfg.checkpoint_every,
+        resume=resume,
+        joiner=resume,
         **adv_kwargs,
     )
     await node.start()
@@ -228,6 +238,11 @@ async def _run_node(cfg: ScenarioConfig, idx: int, ports: list[int],
                      "round_p95_s": node.round_p95_s(),
                      "bytes_in": node.bytes_in,
                      "bytes_out": node.bytes_out,
+                     # per-LINK wire totals: the partition-suspected
+                     # health rule keys on cross-cohort counters going
+                     # one-sided (json turns the int keys into strings)
+                     "peer_bytes_in": dict(node.peer_bytes_in),
+                     "peer_bytes_out": dict(node.peer_bytes_out),
                      "recompiles": obs_trace.xla_recompiles()},
                 )
                 await asyncio.sleep(cfg.protocol.heartbeat_period_s)
@@ -238,7 +253,9 @@ async def _run_node(cfg: ScenarioConfig, idx: int, ports: list[int],
     # fit would otherwise bill its XLA compile to round 1 and skew
     # learn_wall_s, the number the multi-process bench reports
     await asyncio.get_running_loop().run_in_executor(None, learner.warm_up)
-    if cfg.nodes[idx].start:
+    if cfg.nodes[idx].start and not resume:
+        # a resumed relaunch never re-starts the federation: it joins
+        # the running one through the "jr" hello → STATE_SYNC handshake
         learner.init()
         node.set_start_learning(cfg.training.rounds,
                                 cfg.training.epochs_per_round)
@@ -276,7 +293,8 @@ async def _run_node(cfg: ScenarioConfig, idx: int, ports: list[int],
 def node_main(config_path: str, idx: int | list[int], ports: list[int],
               tls_dir: str | None = None,
               hosts: list[str] | None = None,
-              bind: str = "127.0.0.1") -> None:
+              bind: str = "127.0.0.1",
+              resume: bool = False) -> None:
     """Child-process entry. ``idx`` may be a LIST of node indices: all
     of them share this process's event loop (the k-nodes-per-process
     layouts the multi-process bench measures, e.g. 6 processes × 4
@@ -298,7 +316,8 @@ def node_main(config_path: str, idx: int | list[int], ports: list[int],
         return list(
             await asyncio.gather(
                 *(_run_node(cfg, i, ports, tls_dir=tls_dir,
-                            hosts=hosts, bind=bind) for i in idxs)
+                            hosts=hosts, bind=bind, resume=resume)
+                  for i in idxs)
             )
         )
 
@@ -360,6 +379,8 @@ async def _simulate(cfg: ScenarioConfig, timeout: float = 600) -> dict:
             elastic=cfg.elastic,
             fit_slowdown=cfg.nodes[i].fit_slowdown,
             local_epochs=cfg.nodes[i].epochs,
+            checkpoint_dir=cfg.checkpoint_dir,
+            checkpoint_every=cfg.checkpoint_every,
             **adv_kwargs[i],
         )
         for i in range(n)
@@ -394,8 +415,9 @@ async def _simulate(cfg: ScenarioConfig, timeout: float = 600) -> dict:
     # STATE_SYNC model fetch) instead of a scripted beating flag.
     el = cfg.elastic
     joined: list[int] = []
+    restarted: list[int] = []
 
-    async def _rejoin_node(i: int) -> None:
+    async def _rejoin_node(i: int, resume: bool = False) -> None:
         ln = JaxLearner(model=None, data=data.nodes[i],
                         batch_size=cfg.data.batch_size, seed=cfg.seed,
                         trainer=shared)
@@ -410,6 +432,9 @@ async def _simulate(cfg: ScenarioConfig, timeout: float = 600) -> dict:
             fit_slowdown=cfg.nodes[i].fit_slowdown,
             local_epochs=cfg.nodes[i].epochs,
             joiner=True,
+            checkpoint_dir=cfg.checkpoint_dir,
+            checkpoint_every=cfg.checkpoint_every,
+            resume=resume,
             **adv_kwargs[i],
         )
         nodes[i] = nd
@@ -423,42 +448,74 @@ async def _simulate(cfg: ScenarioConfig, timeout: float = 600) -> dict:
                 await nd.connect_to(other.host, other.port)
             except OSError:
                 continue
-        joined.append(i)
+        (restarted if resume else joined).append(i)
 
     status_task = None
+    publish_pass = None
     if cfg.log_dir:
         # simulation-mode status publishing (round 12): the same
         # records _run_node's per-process loop publishes, emitted for
         # every node from one task — so the monitor/healthcheck see an
-        # in-process federation too. A crashed/finished node is
-        # SKIPPED, not final-published: its record ages out exactly
-        # like a killed process's would, which is what the node-dead
-        # rule keys on.
+        # in-process federation too.
         from p2pfl_tpu.utils.monitor import publish_status
 
         status_dir = pathlib.Path(cfg.log_dir) / cfg.name / "status"
 
+        published_final: set[int] = set()
+
+        def publish_pass() -> None:
+            for nd in nodes:
+                if nd.finished.is_set():
+                    # a CRASHED node never publishes again — its record
+                    # ages out like a killed process's, which is what
+                    # the node-dead rule keys on. A node that finished
+                    # the schedule gracefully gets ONE final record so
+                    # the dashboards and the healthcheck see its true
+                    # final round instead of a stale mid-run snapshot.
+                    if nd._crashed or nd.idx in published_final:
+                        continue
+                    published_final.add(nd.idx)
+                publish_status(
+                    status_dir, nd.idx,
+                    {"role": nd.role, "round": nd.round,
+                     "peers": len(nd.peers), "leader": nd.leader,
+                     "round_p95_s": nd.round_p95_s(),
+                     "bytes_in": nd.bytes_in,
+                     "bytes_out": nd.bytes_out,
+                     "peer_bytes_in": dict(nd.peer_bytes_in),
+                     "peer_bytes_out": dict(nd.peer_bytes_out),
+                     "recompiles": obs_trace.xla_recompiles()},
+                )
+
         async def _status_loop() -> None:
             while True:
-                for nd in nodes:
-                    if nd.finished.is_set():
-                        continue
-                    publish_status(
-                        status_dir, nd.idx,
-                        {"role": nd.role, "round": nd.round,
-                         "peers": len(nd.peers), "leader": nd.leader,
-                         "round_p95_s": nd.round_p95_s(),
-                         "bytes_in": nd.bytes_in,
-                         "bytes_out": nd.bytes_out,
-                         "recompiles": obs_trace.xla_recompiles()},
-                    )
+                publish_pass()
                 await asyncio.sleep(cfg.protocol.heartbeat_period_s)
 
         status_task = asyncio.create_task(_status_loop())
 
     fault_task = None
+    watch_tasks: list[asyncio.Task] = []
+    recovery: dict = {"partitions": 0, "heals": 0}
     if cfg.faults:
         events = sorted(cfg.faults, key=lambda f: (f.round, f.node))
+
+        async def _recovery_watch(t_heal: float,
+                                  rounds_at_heal: dict[int, int]) -> None:
+            # chaos_recovery_s: heal observation → first POST-MERGE
+            # round, i.e. every live node has completed a round that
+            # started after the heal (its front moved past the snapshot)
+            while True:
+                live = [nd for nd in nodes if not nd.finished.is_set()]
+                if not live:
+                    break
+                if all(nd.round > rounds_at_heal.get(nd.idx, -1)
+                       for nd in live):
+                    break
+                await asyncio.sleep(0.05)
+            recovery["recovery_s"] = round(time.monotonic() - t_heal, 3)
+            flight.record("sim.recovered",
+                          recovery_s=recovery["recovery_s"])
 
         async def _fault_driver() -> None:
             for f in events:
@@ -472,6 +529,25 @@ async def _simulate(cfg: ScenarioConfig, timeout: float = 600) -> dict:
                     await asyncio.sleep(0.05)
                 if f.kind == "crash":
                     await nodes[f.node].crash()
+                elif f.kind == "partition":
+                    # same cut on every live node → symmetric sever
+                    recovery["partitions"] += 1
+                    for nd in nodes:
+                        if not nd.finished.is_set():
+                            nd.apply_partition(f.groups)
+                elif f.kind == "heal":
+                    recovery["heals"] += 1
+                    snap = {nd.idx: nd.round for nd in nodes
+                            if not nd.finished.is_set()}
+                    for nd in nodes:
+                        if not nd.finished.is_set():
+                            nd.heal_partition()
+                    watch_tasks.append(asyncio.create_task(
+                        _recovery_watch(time.monotonic(), snap)))
+                elif f.kind == "restart":
+                    # crash-consistent relaunch: the fresh node adopts
+                    # the newer of (own checkpoint, peer STATE_SYNC)
+                    await _rejoin_node(f.node, resume=True)
                 else:  # recover / join: live re-entry via the handshake
                     await _rejoin_node(f.node)
 
@@ -495,10 +571,25 @@ async def _simulate(cfg: ScenarioConfig, timeout: float = 600) -> dict:
             fault_task.cancel()
             with contextlib.suppress(asyncio.CancelledError):
                 await fault_task
+        for wt in watch_tasks:
+            # give a still-pending recovery watch one tick to observe
+            # the (now fully finished) federation, then reap it
+            if not wt.done():
+                with contextlib.suppress(asyncio.TimeoutError):
+                    await asyncio.wait_for(wt, timeout=0.5)
+            if not wt.done():
+                wt.cancel()
+                with contextlib.suppress(asyncio.CancelledError):
+                    await wt
         if status_task is not None:
             status_task.cancel()
             with contextlib.suppress(asyncio.CancelledError):
                 await status_task
+        if publish_pass is not None:
+            # one synchronous pass after the loop dies: the LAST node
+            # to finish otherwise races the cancel and never gets its
+            # graceful final record
+            publish_pass()
         for node in nodes:
             await node.stop()
     accs = [
@@ -532,9 +623,15 @@ async def _simulate(cfg: ScenarioConfig, timeout: float = 600) -> dict:
             "crashes": sorted(f.node for f in cfg.faults
                               if f.kind == "crash"),
             "joined": sorted(joined),
+            "restarted": sorted(restarted),
             "stragglers": [i for i in range(n)
                            if cfg.nodes[i].fit_slowdown > 1.0],
         }
+        if recovery["partitions"] or recovery["heals"]:
+            out["churn"]["partitions"] = recovery["partitions"]
+            out["churn"]["heals"] = recovery["heals"]
+            if "recovery_s" in recovery:
+                out["churn"]["recovery_s"] = recovery["recovery_s"]
     if tracer.enabled:
         out["obs"] = tracer.summarize()
         tracer.export(process_name=f"sim[{cfg.name}]")
@@ -566,8 +663,17 @@ def run_simulation(cfg: ScenarioConfig, timeout: float = 600) -> dict:
 
 def launch(cfg: ScenarioConfig, config_path: str | pathlib.Path,
            platform: str | None = None,
-           nodes_per_proc: int = 1) -> list[dict]:
+           nodes_per_proc: int = 1,
+           max_restarts: int = 0,
+           restart_backoff_s: float = 1.0) -> list[dict]:
     """Spawn node processes; collect their results.
+
+    ``max_restarts`` > 0 turns the parent into a supervisor: a child
+    group that dies (non-zero exit) is relaunched with ``--resume`` —
+    each node adopts the newer of its own periodic checkpoint
+    (``cfg.checkpoint_dir``) and a peer's STATE_SYNC — under
+    exponential backoff (``restart_backoff_s * 2^(attempt-1)``, capped
+    at 30 s), up to ``max_restarts`` times per group.
 
     ``nodes_per_proc`` > 1 packs k nodes into each child's event loop
     (``--node "0,1,2,3"``), so a 24-node federation can run as 24×1,
@@ -594,7 +700,12 @@ def launch(cfg: ScenarioConfig, config_path: str | pathlib.Path,
     k = max(int(nodes_per_proc), 1)
     groups = [list(range(i, min(i + k, cfg.n_nodes)))
               for i in range(0, cfg.n_nodes, k)]
-    procs = []
+
+    def _spawn(cmd: list[str]) -> subprocess.Popen:
+        return subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                                stderr=subprocess.STDOUT, text=True)
+
+    cmds, procs = [], []
     for group in groups:
         cmd = [sys.executable, "-m", "p2pfl_tpu.p2p.launch",
                str(config_path), "--node", ",".join(map(str, group)),
@@ -603,13 +714,40 @@ def launch(cfg: ScenarioConfig, config_path: str | pathlib.Path,
             cmd += ["--platform", platform]
         if tls_dir:
             cmd += ["--tls-dir", tls_dir]
-        procs.append(
-            subprocess.Popen(cmd, stdout=subprocess.PIPE,
-                             stderr=subprocess.STDOUT, text=True)
-        )
+        cmds.append(cmd)
+        procs.append(_spawn(cmd))
+
+    def _supervise(gi: int) -> str:
+        """Wait out one group, restarting it (with ``--resume``) on
+        non-zero exit until the restart budget runs dry. Returns the
+        concatenated stdout of every attempt — the parent scans it for
+        P2PFL_RESULT lines, so a successful relaunch reports exactly
+        like an uninterrupted child."""
+        p, attempt, chunks = procs[gi], 0, []
+        while True:
+            out, _ = p.communicate(timeout=900)
+            chunks.append(out)
+            if p.returncode == 0 or attempt >= max_restarts:
+                return "".join(chunks)
+            attempt += 1
+            delay = min(restart_backoff_s * (2.0 ** (attempt - 1)), 30.0)
+            flight.record("launch.restart", group=groups[gi],
+                          attempt=attempt, rc=p.returncode,
+                          backoff_s=round(delay, 3))
+            time.sleep(delay)
+            p = _spawn(cmds[gi] + ["--resume"])
+
+    if max_restarts > 0:
+        # supervise groups concurrently: a crashed group must respawn
+        # while its peers are still mid-federation, not after they exit
+        from concurrent.futures import ThreadPoolExecutor
+
+        with ThreadPoolExecutor(max_workers=len(groups)) as pool:
+            outs = list(pool.map(_supervise, range(len(groups))))
+    else:
+        outs = [_supervise(gi) for gi in range(len(groups))]
     results = []
-    for p in procs:
-        out, _ = p.communicate(timeout=900)
+    for out in outs:
         for line in out.splitlines():
             if line.startswith("P2PFL_RESULT "):
                 results.append(json.loads(line[len("P2PFL_RESULT "):]))
@@ -636,6 +774,15 @@ def main(argv: list[str] | None = None) -> int:
                          "compose service names in a container deployment)")
     ap.add_argument("--bind", default="127.0.0.1",
                     help="listen address (0.0.0.0 inside containers)")
+    ap.add_argument("--resume", action="store_true",
+                    help="child mode: adopt the node's periodic "
+                         "checkpoint before joining (restart path)")
+    ap.add_argument("--max-restarts", type=int, default=0,
+                    help="parent mode: relaunch a dead child group with "
+                         "--resume up to this many times")
+    ap.add_argument("--restart-backoff-s", type=float, default=1.0,
+                    help="base of the exponential restart backoff "
+                         "(doubles per attempt, capped at 30 s)")
     args = ap.parse_args(argv)
     if args.platform:
         import jax
@@ -647,11 +794,14 @@ def main(argv: list[str] | None = None) -> int:
                   [int(p) for p in args.ports.split(",")],
                   tls_dir=args.tls_dir,
                   hosts=args.hosts.split(",") if args.hosts else None,
-                  bind=args.bind)
+                  bind=args.bind,
+                  resume=args.resume)
         return 0
     cfg = ScenarioConfig.load(args.config)
     results = launch(cfg, args.config, platform=args.platform,
-                     nodes_per_proc=args.nodes_per_proc)
+                     nodes_per_proc=args.nodes_per_proc,
+                     max_restarts=args.max_restarts,
+                     restart_backoff_s=args.restart_backoff_s)
     print(json.dumps({"nodes": results}))
     return 0
 

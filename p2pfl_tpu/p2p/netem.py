@@ -24,6 +24,14 @@ Semantics per (src → dst) link:
 - **backpressure**: link queues are bounded; a sender flooding a slow
   link blocks on ``send`` like a full TCP send buffer would, instead
   of growing an infinite buffer that starves every later message.
+- **partition** (round 14): scheduled sever/heal windows
+  (:class:`~p2pfl_tpu.config.schema.PartitionSpec`) drop every message
+  crossing the declared group cut while a window is open — composing
+  with the delay/loss/rate shaping above. The schedule is a pure
+  function of (config, seed): window boundaries, including the
+  optional seeded jitter, are drawn from ``(seed, "partition", k)``
+  and are deliberately NOT per-source, so every node in the
+  federation severs and heals the same cut at the same plan time.
 
 Decisions come from one ``random.Random`` seeded per source node, so a
 given scenario seed yields one reproducible fault schedule per node
@@ -36,6 +44,7 @@ import asyncio
 import random
 from typing import Awaitable, Callable
 
+from p2pfl_tpu.obs import flight
 from p2pfl_tpu.p2p.protocol import Message, write_message
 
 
@@ -55,6 +64,8 @@ class LinkShaper:
         rate_mbps: float = 0.0,
         seed: int = 0,
         on_error: Callable[[object], None] | None = None,
+        partitions=(),
+        on_transition: Callable[[str, list], None] | None = None,
     ):
         self.src = src
         self.delay_s = max(delay_ms, 0.0) / 1000.0
@@ -63,6 +74,21 @@ class LinkShaper:
         self.rate_bps = max(rate_mbps, 0.0) * 1e6 / 8.0  # bytes/s
         self._rng = random.Random((seed, "netem", src).__repr__())
         self._on_error = on_error
+        # partition plan: (start, end, groups, node -> group index).
+        # Boundary jitter is seeded per WINDOW, not per source — the
+        # whole federation must agree on when the cut exists
+        self._windows: list[tuple[float, float, list, dict[int, int]]] = []
+        for k, spec in enumerate(partitions or ()):
+            wrng = random.Random((seed, "partition", k).__repr__())
+            j = float(getattr(spec, "jitter_s", 0.0))
+            start = max(spec.start_s + wrng.uniform(-j, j), 0.0)
+            end = max(start + spec.duration_s + wrng.uniform(-j, j), start)
+            group_of = {int(n): gi for gi, g in enumerate(spec.groups)
+                        for n in g}
+            self._windows.append((start, end, spec.groups, group_of))
+        self._part_active: set[int] = set()
+        self._epoch: float | None = None
+        self._on_transition = on_transition
         # per-destination FIFO: (peer, msg, due) consumed by one worker
         self._queues: dict[int, asyncio.Queue] = {}
         self._workers: dict[int, asyncio.Task] = {}
@@ -70,11 +96,64 @@ class LinkShaper:
         self._last_due: dict[int, float] = {}
         self.sent = 0
         self.dropped = 0
+        self.part_dropped = 0
 
     @property
     def active(self) -> bool:
         return (self.delay_s > 0 or self.jitter_s > 0 or self.loss > 0
-                or self.rate_bps > 0)
+                or self.rate_bps > 0 or bool(self._windows))
+
+    # -- partition plan ----------------------------------------------------
+    def start_clock(self) -> None:
+        """Pin plan time 0 to now (idempotent). Called from node start
+        so every node's windows are measured from federation start;
+        otherwise the epoch pins lazily at the first send."""
+        if self._epoch is None:
+            self._epoch = asyncio.get_event_loop().time()
+
+    def _plan_time(self, now: float) -> float:
+        if self._epoch is None:
+            self._epoch = now
+        return now - self._epoch
+
+    def severed(self, dst: int, t: float) -> bool:
+        """True when plan time ``t`` falls inside a window whose cut
+        separates this source from ``dst``. Nodes outside every group
+        of a window are unaffected by it."""
+        for start, end, _groups, group_of in self._windows:
+            if start <= t < end:
+                gs, gd = group_of.get(self.src), group_of.get(int(dst))
+                if gs is not None and gd is not None and gs != gd:
+                    return True
+        return False
+
+    def severed_now(self, dst: int) -> bool:
+        """``severed`` against the live plan clock — the node's probe
+        machinery asks this before trusting a TCP dial across the cut."""
+        if not self._windows:
+            return False
+        return self.severed(dst,
+                            self._plan_time(asyncio.get_event_loop().time()))
+
+    def _note_transitions(self, t: float) -> None:
+        """Record sever/heal edges (flight + callback) as plan time
+        crosses window boundaries. Piggybacked on send(), so detection
+        latency is one outbound message — at most a heartbeat period."""
+        now_active = {k for k, (s, e, _g, _m) in enumerate(self._windows)
+                      if s <= t < e}
+        for k in sorted(now_active - self._part_active):
+            groups = self._windows[k][2]
+            flight.record("netem.partition", src=self.src, window=k,
+                          groups=groups, t=round(t, 3))
+            if self._on_transition is not None:
+                self._on_transition("partition", groups)
+        for k in sorted(self._part_active - now_active):
+            groups = self._windows[k][2]
+            flight.record("netem.heal", src=self.src, window=k,
+                          groups=groups, t=round(t, 3))
+            if self._on_transition is not None:
+                self._on_transition("heal", groups)
+        self._part_active = now_active
 
     def _size(self, msg: Message) -> int:
         return len(msg.payload or b"") + 256  # header/body estimate
@@ -83,10 +162,16 @@ class LinkShaper:
         """Queue ``msg`` for ``peer`` under the link schedule. Blocks
         only when the link's bounded queue is full (backpressure);
         delivery happens on the link worker."""
+        loop = asyncio.get_event_loop()
+        if self._windows:
+            t = self._plan_time(loop.time())
+            self._note_transitions(t)
+            if self.severed(peer.idx, t):
+                self.part_dropped += 1
+                return
         if self.loss and self._rng.random() < self.loss:
             self.dropped += 1
             return
-        loop = asyncio.get_event_loop()
         now = loop.time()
         # link occupancy: serialization time at the configured rate,
         # FIFO behind whatever is already scheduled on this link
@@ -127,9 +212,11 @@ class LinkShaper:
         self._queues.clear()
 
 
-def shaper_from_config(src: int, net, on_error=None) -> LinkShaper | None:
+def shaper_from_config(src: int, net, on_error=None,
+                       on_transition=None) -> LinkShaper | None:
     """Build a shaper from a ``NetworkConfig`` (None or all-zero →
-    no shaping, zero-overhead direct writes)."""
+    no shaping, zero-overhead direct writes). A partition plan alone
+    activates the shaper even with all rate/delay/loss knobs at zero."""
     if net is None:
         return None
     s = LinkShaper(
@@ -140,5 +227,7 @@ def shaper_from_config(src: int, net, on_error=None) -> LinkShaper | None:
         rate_mbps=getattr(net, "rate_mbps", 0.0),
         seed=net.seed,
         on_error=on_error,
+        partitions=getattr(net, "partitions", ()),
+        on_transition=on_transition,
     )
     return s if s.active else None

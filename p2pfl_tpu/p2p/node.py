@@ -47,6 +47,7 @@ from p2pfl_tpu.core.serialize import (
     encode_parameters,
     quantize_int8,
 )
+from p2pfl_tpu.federation.events import Events
 from p2pfl_tpu.federation.membership import Membership
 from p2pfl_tpu.obs import flight
 from p2pfl_tpu.obs.trace import get_tracer
@@ -133,6 +134,9 @@ class P2PNode:
         fit_slowdown: float = 1.0,
         local_epochs: int | None = None,
         joiner: bool = False,
+        checkpoint_dir: str | None = None,
+        checkpoint_every: int = 0,
+        resume: bool = False,
     ):
         from p2pfl_tpu.p2p.session import AggregationSession
 
@@ -182,7 +186,9 @@ class P2PNode:
         # send path stays a direct socket write
         from p2pfl_tpu.p2p.netem import shaper_from_config
 
-        self.shaper = shaper_from_config(idx, netem, on_error=self._drop_conn)
+        self.shaper = shaper_from_config(
+            idx, netem, on_error=self._drop_conn,
+            on_transition=self._on_netem_transition)
         # adversary hooks (p2pfl_tpu.adversary): ``attack`` is an
         # AttackSpec THIS node applies to its own outgoing update
         # (a malicious node attacks; honest nodes pass None);
@@ -222,6 +228,13 @@ class P2PNode:
         self._lane = f"node{idx}"
         self.bytes_in = 0
         self.bytes_out = 0
+        # always-on per-peer wire totals (round 14): two dict-int adds
+        # per frame, published with the status record so the health
+        # plane can see per-LINK silence — a partition is invisible in
+        # the plain totals (gossip inside one side keeps them growing)
+        # but shows as cross-cut per-peer counters going one-sided
+        self.peer_bytes_in: dict[int, int] = {}
+        self.peer_bytes_out: dict[int, int] = {}
         # per-round wall clocks (appended by _learning_loop) — the p95
         # the status publisher reports comes from here
         self.round_wall_s: list[float] = []
@@ -236,6 +249,20 @@ class P2PNode:
         self.fit_slowdown = float(fit_slowdown)
         self.local_epochs = local_epochs
         self.joiner = bool(joiner)
+        # crash-consistent auto-resume (round 14): with a checkpoint
+        # dir configured the node snapshots (params, round) every
+        # ``checkpoint_every`` rounds; ``resume=True`` relaunches it
+        # from the newest of (own checkpoint, peer STATE_SYNC)
+        self.checkpoint_dir = checkpoint_dir
+        self.checkpoint_every = int(checkpoint_every)
+        self.resume = bool(resume)
+        # the round the on-disk checkpoint carried; STATE_SYNC adoption
+        # compares against it (newer wins) and clears it once decided
+        self._resume_round: int | None = None
+        # peers currently behind a scripted partition cut — outbound
+        # frames to them are dropped at the write layer (both sides of
+        # the cut hold the same set, so the sever is symmetric)
+        self._severed: set[int] = set()
         # dial-back addresses, learned from CONNECT hellos — reconnect
         # probes redial these when a peer's heartbeats go silent
         self._peer_addrs: dict[int, tuple[str, int]] = {}
@@ -310,7 +337,44 @@ class P2PNode:
         )
         self.port = self._server.sockets[0].getsockname()[1]
         self.membership.beat(self.idx, 0.0)
+        if self.shaper is not None:
+            # partition-plan time 0 = node start, not first send
+            self.shaper.start_clock()
+        if self.resume and self.checkpoint_dir:
+            self._try_resume()
         self._tasks.append(asyncio.create_task(self._heartbeat_loop()))
+
+    def _try_resume(self) -> None:
+        """Crash-consistent restart (round 14): adopt this node's own
+        periodic checkpoint before any peer contact. A later STATE_SYNC
+        only overrides it when the peer's round is NEWER (see
+        ``_on_state_sync``). A torn checkpoint is reported loudly
+        (the loader names the file) but does not kill the relaunch —
+        the node falls back to the plain joiner path."""
+        from p2pfl_tpu.federation.checkpoint import load_node_checkpoint
+
+        ln = self.learner
+        if (getattr(ln, "state", True) is None
+                or getattr(ln, "fns", True) is None):
+            ln.init()
+        try:
+            got = load_node_checkpoint(self.checkpoint_dir, self.idx,
+                                       ln.get_parameters())
+        except ValueError as e:
+            log.warning("node %d resume failed, joining fresh: %s",
+                        self.idx, e)
+            flight.record("checkpoint.resume_failed", node=self.idx,
+                          error=str(e)[:200])
+            return
+        if got is None:
+            flight.record("checkpoint.resume_missing", node=self.idx)
+            return
+        params, rnd = got
+        ln.set_parameters(params)
+        self.initialized = True
+        self.round = rnd
+        self._resume_round = rnd
+        flight.record("checkpoint.resume", node=self.idx, round=rnd)
 
     async def crash(self) -> None:
         """Failure injection (round 11 churn): abrupt teardown WITHOUT
@@ -346,6 +410,64 @@ class P2PNode:
         # postmortem: the crash is exactly the moment the ring's
         # churn history stops being reconstructible any other way
         flight.dump(f"node{self.idx}.crash")
+
+    # ------------------------------------------------------------------
+    # partition control (round 14): the fault driver's scripted cut
+    # ------------------------------------------------------------------
+    def apply_partition(self, groups: list) -> None:
+        """Sever every link crossing the ``groups`` cut, as seen from
+        this node: outbound frames to peers in OTHER groups are dropped
+        at the write layer. The driver applies the same cut on every
+        node, so the sever is symmetric. A node absent from all groups
+        is unaffected. Flows through membership as a ``partition``
+        FaultEvent → Events.LINK_PARTITIONED + flight record."""
+        mine = next((g for g in groups if self.idx in g), None)
+        if mine is None:
+            return
+        others = {int(n) for g in groups if g is not mine for n in g}
+        self._severed |= others - {self.idx}
+        flight.record("node.partition", node=self.idx, round=self.round,
+                      severed=sorted(self._severed))
+        self.membership.apply_fault(
+            FaultEvent(node=self.idx, kind="partition", groups=groups))
+
+    def heal_partition(self) -> None:
+        """The heal observation: reconnect all scripted cuts and grant
+        eviction amnesty. Membership clears every sticky departure and
+        re-arms an immediately-due probe; the existing probe machinery
+        then redials each healed peer (``_peer_addrs``) and its first
+        beat resurrects it — no operator action, no new merge math
+        (the minority's model re-enters as a staleness-discounted
+        ``add_model`` contribution via the round-11 stale-fold path)."""
+        if not self._severed:
+            return
+        healed = sorted(self._severed)
+        self._severed.clear()
+        flight.record("node.heal", node=self.idx, round=self.round,
+                      healed=healed)
+        self.membership.apply_fault(FaultEvent(node=self.idx, kind="heal"))
+
+    def _on_netem_transition(self, kind: str, groups: list) -> None:
+        """Shaper-scheduled windows (NetworkConfig.partitions) reuse
+        the same observation path as driver-scripted cuts. The shaper
+        already drops the frames; here only the membership event +
+        amnesty bookkeeping run. Severed-set updates are skipped for
+        ``partition`` (the shaper owns the drop), but ``heal`` must
+        still clear driver-applied state and trigger amnesty."""
+        if kind == "partition":
+            self.membership.apply_fault(
+                FaultEvent(node=self.idx, kind="partition", groups=groups))
+        else:
+            self._severed.clear()
+            self.membership.apply_fault(
+                FaultEvent(node=self.idx, kind="heal"))
+
+    def _link_severed(self, node: int) -> bool:
+        """True while an open cut (driver- or shaper-scheduled)
+        separates this node from ``node``."""
+        if node in self._severed:
+            return True
+        return self.shaper is not None and self.shaper.severed_now(node)
 
     async def stop(self) -> None:
         if self._crashed:
@@ -681,6 +803,8 @@ class P2PNode:
 
     def _count_rx(self, peer: PeerState, msg: Message) -> None:
         self.bytes_in += msg._wire_bytes
+        pb = self.peer_bytes_in
+        pb[peer.idx] = pb.get(peer.idx, 0) + msg._wire_bytes
         tr = self._tracer
         if tr.enabled:
             tr.count(f"rx_bytes/peer{peer.idx}", msg._wire_bytes)
@@ -689,6 +813,8 @@ class P2PNode:
     def _count_tx(self, peer: PeerState, msg: Message) -> None:
         n = msg.wire_size()
         self.bytes_out += n
+        pb = self.peer_bytes_out
+        pb[peer.idx] = pb.get(peer.idx, 0) + n
         tr = self._tracer
         if tr.enabled:
             tr.count(f"tx_bytes/peer{peer.idx}", n)
@@ -911,10 +1037,25 @@ class P2PNode:
         to its round, then enter the running federation. Only declared
         joiners act on STATE_SYNC, the round fast-forward never rewinds,
         and the model is adopted at most once (first answer wins — the
-        init-params catch-up from _sync_peer may already have landed)."""
+        init-params catch-up from _sync_peer may already have landed).
+
+        A checkpoint-resumed relaunch (round 14) arrives already
+        initialized with its disk state at ``_resume_round``; the first
+        STATE_SYNC then decides ONCE which side is newer — the peer's
+        model is adopted only when its round is strictly ahead of the
+        checkpoint, otherwise the (at least as fresh) disk state
+        stands."""
         if not self.joiner:
             return
         rnd = int(msg.body.get("round", 0))
+        adopt_over_resume = (self.initialized
+                             and self._resume_round is not None
+                             and rnd > self._resume_round)
+        if self._resume_round is not None and not self.learning:
+            flight.record("checkpoint.resume_decision", node=self.idx,
+                          checkpoint_round=self._resume_round,
+                          sync_round=rnd, adopt_sync=adopt_over_resume)
+            self._resume_round = None  # first answer decides
         flight.record("checkpoint.state_sync_in", node=self.idx,
                       peer=int(msg.sender), round=rnd)
         with self._tracer.span("p2p.join", lane=self._lane,
@@ -932,7 +1073,7 @@ class P2PNode:
                         self._join_round_target or 0, rnd)
                 else:
                     self.round = rnd
-            if not self.initialized:
+            if not self.initialized or adopt_over_resume:
                 ln = self.learner
                 if (getattr(ln, "state", True) is None
                         or getattr(ln, "fns", True) is None):
@@ -1012,6 +1153,8 @@ class P2PNode:
         them, so the sole-writer-per-connection invariant holds.
         Returns True when the frame was handled (written or the
         connection dropped), False when the caller must queue."""
+        if peer.idx in self._severed:
+            return True  # scripted partition: the frame dies on the cut
         q = peer.send_q
         if (q is None or not q.empty() or peer.draining
                 or self.peers.get(peer.idx) is not peer):
@@ -1035,6 +1178,8 @@ class P2PNode:
         Blocks only when THIS peer's bounded queue is full
         (backpressure); never raises for delivery errors — those
         surface on the drain/link worker, which drops the connection."""
+        if peer.idx in self._severed:
+            return  # scripted partition (fault driver): symmetric drop
         if self.shaper is not None:
             await self.shaper.send(peer, msg)
         elif self._try_fast_write(peer, msg):
@@ -1222,6 +1367,14 @@ class P2PNode:
         the same bounded path to eviction. Once the retry budget is
         exhausted the death goes sticky (_evict_dead)."""
         for node in self.membership.probes_due():
+            if self._link_severed(node):
+                # a probe cannot succeed across an open partition cut —
+                # but the in-process emulation's TCP dial WOULD (the cut
+                # drops frames, it doesn't close sockets), so count the
+                # failure here instead of letting the dial lie
+                if self.membership.probe_failed(node):
+                    self._evict_dead(node)
+                continue
             conn = self.peers.get(node)
             if conn is not None and not conn.writer.is_closing():
                 if self.membership.probe_failed(node):
@@ -1432,6 +1585,7 @@ class P2PNode:
                                    args={"round": self.round}):
                 await self._train_round()
             self.round_wall_s.append(time.monotonic() - t0)
+            self._maybe_checkpoint()
         self.learn_t1 = time.monotonic()
         # final evaluation, shared with the federation (the metrics
         # flood the reference stubbed out, node.py:611-620 + 875-878)
@@ -1448,6 +1602,27 @@ class P2PNode:
             log.exception("node %d final evaluate failed", self.idx)
         self.learning = False
         self.finished.set()
+
+    def _maybe_checkpoint(self) -> None:
+        """Round-boundary per-node checkpoint (round 14). Runs on the
+        loop — the blob is one small msgpack serialize plus an fsynced
+        file replace; a crash between rounds then restarts from a state
+        at most ``checkpoint_every`` rounds old. Failures are reported
+        and swallowed: checkpointing must never kill a healthy round
+        loop (a full disk is an ops alert, not a training fault)."""
+        if (not self.checkpoint_dir or self.checkpoint_every <= 0
+                or self.round % self.checkpoint_every != 0):
+            return
+        from p2pfl_tpu.federation.checkpoint import save_node_checkpoint
+
+        try:
+            save_node_checkpoint(self.checkpoint_dir, self.idx,
+                                 self.learner.get_parameters(), self.round)
+            self.membership.notify(Events.CHECKPOINT_SAVED,
+                                   {"node": self.idx, "round": self.round})
+        except Exception as e:
+            log.warning("node %d checkpoint failed at round %d: %s",
+                        self.idx, self.round, e)
 
     async def _diffuse_initial(self) -> None:
         params = self.learner.get_parameters()

@@ -47,6 +47,8 @@ HEADLINE: dict[str, str] = {
     "cifar16_dirichlet_round_s": "lower",
     "cpu8_ring_dense_round_s": "lower",
     "crossdev_round_s_10k": "lower",
+    "chaos_recovery_s": "lower",
+    "chaos_final_accuracy": "higher",
 }
 DEFAULT_TOL = 0.15
 

@@ -30,7 +30,8 @@ def test_zero_budget_still_emits_parseable_json():
     # everywhere), every phase is explicitly accounted as skipped
     assert set(out["skipped_phases"]) == {
         "headline", "cifar16", "cpu8", "socket24", "comm", "socket_mp",
-        "obs", "obs_health", "robust", "elastic", "cross_device", "vit32"
+        "obs", "obs_health", "robust", "elastic", "cross_device",
+        "chaos", "vit32"
     }
     # the provenance stamp (round 12) rides the envelope even at zero
     # budget — a regression report must always name its commit
@@ -188,6 +189,30 @@ def test_cross_device_phase_dry_run_emits_key_plan():
     assert {"crossdev_round_s_10k", "crossdev_clients_per_s",
             "crossdev_cohort_scaling", "crossdev_rounds_to_target",
             "crossdev_xla_recompiles"} <= planned
+    assert planned <= set(bench.BENCH_KEYS)
+
+
+def test_chaos_phase_dry_run_emits_key_plan():
+    """P2PFL_CHAOS_DRY=1: the chaos phase must emit its planned key
+    list as one parseable part without touching jax — the round-14
+    analog of the obs_health dry-run hook."""
+    env = dict(os.environ, P2PFL_CHAOS_DRY="1")
+    code = (f"import sys; sys.path.insert(0, {str(REPO)!r})\n"
+            "import bench; bench._phase_chaos()\n")
+    res = subprocess.run([sys.executable, "-c", code],
+                         capture_output=True, text=True, timeout=60,
+                         env=env, cwd=REPO)
+    assert res.returncode == 0, res.stderr[-500:]
+    sys.path.insert(0, str(REPO))
+    import bench
+
+    parts = [json.loads(line[len(bench._PART_TAG):])
+             for line in res.stdout.splitlines()
+             if line.startswith(bench._PART_TAG)]
+    assert len(parts) == 1 and parts[0]["chaos_dry"] is True
+    planned = set(parts[0]["chaos_keys"])
+    assert {"chaos_recovery_s", "chaos_final_accuracy",
+            "chaos_clean_accuracy", "chaos_accuracy_gap"} <= planned
     assert planned <= set(bench.BENCH_KEYS)
 
 

@@ -1,0 +1,502 @@
+"""Partition tolerance (round 14): the scheduled netem partition plan,
+split-brain survival with eviction amnesty on heal, crash-consistent
+per-node checkpoints and auto-resume, the partition-suspected health
+rule, and the scripted chaos schedule end-to-end on real sockets.
+
+Socket tests reuse test_p2p's shared-trainer learner factory (same
+reason test_netem/test_elastic do: per-test recompiles of n identical
+XLA programs waste tens of suite seconds).
+"""
+
+import asyncio
+import pathlib
+
+import jax
+import numpy as np
+import pytest
+
+from p2pfl_tpu.config.schema import (
+    DataConfig,
+    ElasticConfig,
+    FaultEvent,
+    NetworkConfig,
+    PartitionSpec,
+    ProtocolConfig,
+    ScenarioConfig,
+    TrainingConfig,
+)
+from p2pfl_tpu.federation.checkpoint import (
+    load_node_checkpoint,
+    node_checkpoint_path,
+    pack_model,
+    save_node_checkpoint,
+)
+from p2pfl_tpu.federation.events import Events
+from p2pfl_tpu.federation.membership import Membership
+from p2pfl_tpu.obs import flight
+from p2pfl_tpu.obs.health import HealthConfig, HealthEngine
+from p2pfl_tpu.p2p import Message, MsgType
+from p2pfl_tpu.p2p.netem import LinkShaper, shaper_from_config
+
+from test_elastic import _PROTO, _node
+from test_netem import _FakePeer, _Recorder
+from test_p2p import _make_learners
+
+
+# ---------------------------------------------------------------------------
+# netem partition plan: determinism + cut semantics + send-path sever/heal
+# ---------------------------------------------------------------------------
+
+
+class TestPartitionPlan:
+    def test_windows_federation_symmetric_and_seed_deterministic(self):
+        """Boundary jitter is seeded per WINDOW, not per source: every
+        node in the federation must compute the SAME sever/heal times
+        from (config, seed), or the cut would be asymmetric."""
+        spec = PartitionSpec(start_s=1.0, duration_s=2.0,
+                             groups=[[0, 1], [2, 3]], jitter_s=0.5)
+        a = LinkShaper(0, seed=7, partitions=[spec])
+        b = LinkShaper(3, seed=7, partitions=[spec])
+        assert a._windows[0][:2] == b._windows[0][:2]
+        # same (config, seed) twice -> the identical schedule
+        again = LinkShaper(0, seed=7, partitions=[spec])
+        assert again._windows[0][:2] == a._windows[0][:2]
+        # a different seed draws different jittered boundaries
+        other = LinkShaper(0, seed=8, partitions=[spec])
+        assert other._windows[0][:2] != a._windows[0][:2]
+        # two windows of one plan draw INDEPENDENT jitter (keyed on k)
+        twin = PartitionSpec(start_s=1.0, duration_s=2.0,
+                             groups=[[0, 1], [2, 3]], jitter_s=0.5)
+        two = LinkShaper(0, seed=7, partitions=[spec, twin])
+        assert two._windows[0][:2] != two._windows[1][:2]
+
+    def test_severed_cut_semantics(self):
+        spec = PartitionSpec(start_s=1.0, duration_s=2.0,
+                             groups=[[0, 1], [2, 3]])
+        s = LinkShaper(0, seed=0, partitions=[spec])
+        assert s.active  # a plan alone activates the shaper
+        # inside the window: only links CROSSING the cut are severed
+        assert s.severed(2, 1.5) and s.severed(3, 1.0)
+        assert not s.severed(1, 1.5)  # same side
+        assert not s.severed(4, 1.5)  # dst outside every group
+        # outside the window nothing is severed (end-exclusive)
+        assert not s.severed(2, 0.99) and not s.severed(2, 3.0)
+        # a SOURCE outside every group is unaffected by the window
+        out = LinkShaper(4, seed=0, partitions=[spec])
+        assert not out.severed(0, 1.5)
+
+    def test_send_drops_in_window_heals_after_and_composes_with_loss(
+            self, monkeypatch):
+        async def main():
+            rec = _Recorder()
+            monkeypatch.setattr(
+                "p2pfl_tpu.p2p.netem.write_message", rec.write)
+            transitions = []
+            spec = PartitionSpec(start_s=0.0, duration_s=0.3,
+                                 groups=[[0], [1]])
+            # 100% loss proves ordering: a severed frame is counted as
+            # part_dropped (the loss stage never sees it); after the
+            # heal the same link's frames fall through to loss
+            s = LinkShaper(src=0, loss_pct=100.0, seed=3,
+                           partitions=[spec],
+                           on_transition=lambda k, g:
+                           transitions.append((k, g)))
+            s.start_clock()  # plan time 0 = now -> window open
+            peer = _FakePeer(1)
+            await s.send(peer, "cut")
+            assert s.part_dropped == 1 and s.dropped == 0
+            assert not rec.delivered
+            assert transitions == [("partition", spec.groups)]
+            await asyncio.sleep(0.35)
+            await s.send(peer, "after")
+            assert transitions[-1] == ("heal", spec.groups)
+            assert s.part_dropped == 1 and s.dropped == 1
+            s.close()
+
+        asyncio.run(main())
+
+    def test_shaper_from_config_partition_plan_alone_activates(self):
+        spec = PartitionSpec(start_s=1.0, duration_s=1.0,
+                             groups=[[0, 1], [2, 3]])
+        s = shaper_from_config(0, NetworkConfig(partitions=[spec]))
+        assert s is not None and s.active
+        # no plan + no shaping stays zero-overhead (None)
+        assert shaper_from_config(0, NetworkConfig()) is None
+
+
+# ---------------------------------------------------------------------------
+# eviction amnesty: the round-11 sticky-evict dead end, fixed
+# ---------------------------------------------------------------------------
+
+
+def _machine():
+    proto = ProtocolConfig(heartbeat_period_s=0.2, node_timeout_s=1.0)
+    m = Membership(4, proto, virtual=False, retry_limit=3,
+                   backoff_base_s=0.5, backoff_max_s=8.0)
+    events = []
+    m.add_observer(lambda e, p: events.append((e, p.get("node"))))
+    for i in range(4):
+        m.beat(i, t=0.0)
+    return m, events
+
+
+class TestEvictionAmnesty:
+    def test_amnesty_reopens_probe_window_after_sticky_evict(self):
+        """Regression for the round-11 dead end: once the retry budget
+        was exhausted and the node evicted, NOTHING could bring it back
+        short of a fresh join hello. Amnesty (keyed on a heal
+        observation, not the budget) re-arms the probe machine."""
+        m, events = _machine()
+        for i in range(3):
+            m.beat(i, t=2.0)
+        m.advance_to(2.5)  # node 3 silent past node_timeout_s
+        for t in (3.0, 4.0, 6.0):
+            final = m.probe_failed(3, t=t)
+        assert final is True  # budget exhausted
+        m.evict(3)
+        assert m.departed[3] and m.probes_due(100.0) == []  # dead end
+        m.amnesty(3, t=100.0)
+        assert not m.departed[3]
+        assert int(m.probe_failures[3]) == 0
+        assert m.probes_due(100.0) == [3]  # immediately-due fresh probe
+        # amnesty is NOT resurrection: reachability must be proven
+        assert 3 not in m.get_nodes()
+        m.beat(3, t=100.1)
+        assert 3 in m.get_nodes()
+        assert (Events.NODE_RECOVERED, 3) in events
+
+    def test_amnesty_is_noop_on_a_healthy_node(self):
+        m, _ = _machine()
+        before = float(m.next_probe[0])
+        m.amnesty(0, t=50.0)
+        assert 0 in m.get_nodes() and not m.departed[0]
+        assert float(m.next_probe[0]) == before  # nothing to forgive
+
+    def test_heal_fault_amnesties_every_departure(self):
+        m, events = _machine()
+        m.evict(2)
+        m.evict(3)
+        assert m.probes_due(10.0) == []
+        m.apply_fault(FaultEvent(node=0, kind="heal"))
+        assert not m.departed[2] and not m.departed[3]
+        assert sorted(m.probes_due(m.clock)) == [2, 3]
+        assert (Events.LINK_HEALED, None) in events
+        m.beat(2, t=m.clock + 0.1)
+        m.beat(3, t=m.clock + 0.1)
+        assert m.get_nodes() == [0, 1, 2, 3]
+
+    def test_partition_fault_records_event_without_evicting(self):
+        m, events = _machine()
+        m.apply_fault(FaultEvent(node=0, kind="partition",
+                                 groups=[[0, 1], [2, 3]]))
+        assert (Events.LINK_PARTITIONED, None) in events
+        # the transport owns the cut; membership state is untouched
+        assert m.get_nodes() == [0, 1, 2, 3]
+
+
+# ---------------------------------------------------------------------------
+# crash-consistent per-node checkpoints
+# ---------------------------------------------------------------------------
+
+
+def _tree(v):
+    return {"w": np.full((4, 3), v, np.float32),
+            "b": np.zeros((3,), np.float32)}
+
+
+def test_truncated_checkpoint_fails_loudly_naming_the_file(tmp_path):
+    """A torn write (crash mid-save without the atomic replace) must
+    surface as a ValueError NAMING the file — not as a silent garbage
+    model or a bare msgpack traceback."""
+    save_node_checkpoint(tmp_path, 0, _tree(1.5), 7)
+    path = node_checkpoint_path(tmp_path, 0)
+    blob = path.read_bytes()
+    for cut in (len(blob) // 2, 5):
+        path.write_bytes(blob[:cut])
+        with pytest.raises(ValueError, match=path.name):
+            load_node_checkpoint(tmp_path, 0, _tree(0.0))
+    # the intact bytes restore cleanly — the failure was the torn file
+    path.write_bytes(blob)
+    params, rnd = load_node_checkpoint(tmp_path, 0, _tree(0.0))
+    assert rnd == 7
+    np.testing.assert_array_equal(params["w"], _tree(1.5)["w"])
+
+
+def test_checkpoint_atomic_replace_latest_wins(tmp_path):
+    save_node_checkpoint(tmp_path, 2, _tree(1.0), 1)
+    save_node_checkpoint(tmp_path, 2, _tree(2.0), 4)
+    params, rnd = load_node_checkpoint(tmp_path, 2, _tree(0.0))
+    assert rnd == 4
+    np.testing.assert_array_equal(params["w"], _tree(2.0)["w"])
+    # os.replace semantics: one file per node, no tmp litter
+    assert sorted(p.name for p in tmp_path.iterdir()) == [
+        "node_002.ckpt.msgpack"]
+    # a node that never saved resumes as None, not as an error
+    assert load_node_checkpoint(tmp_path, 3, _tree(0.0)) is None
+
+
+# ---------------------------------------------------------------------------
+# auto-resume: own checkpoint vs peer STATE_SYNC, newer wins (once)
+# ---------------------------------------------------------------------------
+
+
+def _bump(params, delta):
+    return jax.tree_util.tree_map(lambda x: np.asarray(x) + delta, params)
+
+
+def _kernel(params):
+    return np.asarray(params["params"]["Dense_0"]["kernel"])
+
+
+class TestCrashResume:
+    def test_resume_adopts_own_checkpoint_before_any_peer_contact(
+            self, tmp_path):
+        async def main():
+            _, learners = _make_learners(2, samples=60)
+            src = learners[0]
+            src.init()
+            disk = _bump(src.get_parameters(), 1.0)
+            save_node_checkpoint(tmp_path, 1, disk, 3)
+            nd = _node(1, learners[1], _PROTO, joiner=True, resume=True,
+                       checkpoint_dir=str(tmp_path))
+            await nd.start()
+            try:
+                assert nd.initialized and nd.round == 3
+                assert nd._resume_round == 3
+                np.testing.assert_array_equal(
+                    _kernel(nd.learner.get_parameters()), _kernel(disk))
+            finally:
+                await nd.stop()
+
+        asyncio.run(main())
+
+    def test_state_sync_older_than_checkpoint_keeps_disk_state(
+            self, tmp_path):
+        """The restart path must not let a LAGGING peer rewind a node
+        past its own crash-consistent state: the first STATE_SYNC
+        decides once, and only a strictly newer round wins."""
+
+        async def main():
+            _, learners = _make_learners(2, samples=60)
+            src = learners[0]
+            src.init()
+            disk = _bump(src.get_parameters(), 1.0)
+            save_node_checkpoint(tmp_path, 1, disk, 3)
+            nd = _node(1, learners[1], _PROTO, joiner=True, resume=True,
+                       checkpoint_dir=str(tmp_path))
+            await nd.start()
+            try:
+                stale = _bump(src.get_parameters(), 5.0)
+                msg = Message(
+                    MsgType.STATE_SYNC, 0,
+                    {"round": 2, "rounds": 6, "epochs": 1, "leader": 0},
+                    payload=pack_model(stale, 2),
+                )
+                await nd._on_state_sync(msg)
+                assert nd.round == 3  # no rewind
+                np.testing.assert_array_equal(
+                    _kernel(nd.learner.get_parameters()), _kernel(disk))
+                assert nd._resume_round is None  # first answer decided
+            finally:
+                await nd.stop()
+
+        asyncio.run(main())
+
+    def test_state_sync_newer_than_checkpoint_wins(self, tmp_path):
+        async def main():
+            _, learners = _make_learners(2, samples=60)
+            src = learners[0]
+            src.init()
+            disk = _bump(src.get_parameters(), 1.0)
+            save_node_checkpoint(tmp_path, 1, disk, 1)
+            nd = _node(1, learners[1], _PROTO, joiner=True, resume=True,
+                       checkpoint_dir=str(tmp_path))
+            await nd.start()
+            try:
+                fresh = _bump(src.get_parameters(), 5.0)
+                msg = Message(
+                    MsgType.STATE_SYNC, 0,
+                    {"round": 4, "rounds": 6, "epochs": 1, "leader": 0},
+                    payload=pack_model(fresh, 4),
+                )
+                await nd._on_state_sync(msg)
+                assert nd.round == 4
+                np.testing.assert_array_equal(
+                    _kernel(nd.learner.get_parameters()), _kernel(fresh))
+            finally:
+                await nd.stop()
+
+        asyncio.run(main())
+
+
+# ---------------------------------------------------------------------------
+# partition-suspected health rule: fire on a one-sided cut, clear on heal
+# ---------------------------------------------------------------------------
+
+
+def _status(node, now, peers):
+    return {"node": node, "ts": now, "round": 3,
+            # JSON round-trip stringifies peer keys — exercise that
+            "peer_bytes_in": {str(p): b for p, b in peers.items()},
+            "peer_bytes_out": {}}
+
+
+def _cohort(n=6):
+    cnt = {a: {b: 100 for b in range(n) if b != a} for a in range(n)}
+    intra = [(a, b) for a in range(n) for b in range(n)
+             if a != b and (a < n // 2) == (b < n // 2)]
+    cross = [(a, b) for a in range(n) for b in range(n)
+             if a != b and (a < n // 2) != (b < n // 2)]
+
+    def recs(now):
+        return [_status(a, now, cnt[a]) for a in range(n)]
+
+    def grow(pairs, by=10):
+        for a, b in pairs:
+            cnt[a][b] += by
+
+    return recs, grow, intra, cross
+
+
+def _part_alerts(alerts):
+    return [a for a in alerts if a.rule == "partition-suspected"]
+
+
+class TestPartitionSuspectedRule:
+    def test_fires_on_one_sided_cut_and_clears_on_heal(self):
+        recs, grow, intra, cross = _cohort()
+        eng = HealthEngine()
+        # first snapshot: no delta baseline yet -> can never fire
+        assert not _part_alerts(eng.evaluate(recs(100.0), now=100.0))
+        # healthy mesh: every link (intra AND cross) moved bytes
+        grow(intra)
+        grow(cross)
+        assert not _part_alerts(eng.evaluate(recs(101.0), now=101.0))
+        # the cut: each side keeps gossiping internally, every
+        # cross-cut counter freezes -> one federation-level crit
+        grow(intra)
+        part = _part_alerts(eng.evaluate(recs(102.0), now=102.0))
+        assert len(part) == 1
+        assert part[0].node is None and part[0].severity == "crit"
+        assert "{0,1,2}" in part[0].message
+        assert "{3,4,5}" in part[0].message
+        assert eng.worst() == "crit"
+        # heal: traffic crosses the cut again -> the alert clears
+        grow(intra)
+        grow(cross)
+        assert not _part_alerts(eng.evaluate(recs(103.0), now=103.0))
+        assert any(t["event"] == "clear"
+                   and t["rule"] == "partition-suspected"
+                   for t in eng.transitions)
+
+    def test_fully_quiescent_cohort_is_not_a_partition(self):
+        """Zero deltas EVERYWHERE (a finished run's corpse, a global
+        stall) must read as stall/dead territory, not as n singleton
+        cohorts — a real cut keeps each side gossiping internally."""
+        recs, grow, intra, cross = _cohort()
+        eng = HealthEngine()
+        eng.evaluate(recs(100.0), now=100.0)
+        assert not _part_alerts(eng.evaluate(recs(101.0), now=101.0))
+
+
+# ---------------------------------------------------------------------------
+# the chaos schedule end-to-end: split-brain + crash + restart on sockets
+# ---------------------------------------------------------------------------
+
+
+def _chaos_cfg(name, tmp_path, faults):
+    return ScenarioConfig(
+        name=name, n_nodes=8, topology="fully",
+        data=DataConfig(dataset="mnist", samples_per_node=150),
+        training=TrainingConfig(rounds=6, epochs_per_round=1,
+                                learning_rate=0.1),
+        protocol=ProtocolConfig(heartbeat_period_s=0.2,
+                                aggregation_timeout_s=15.0,
+                                vote_timeout_s=3.0, node_timeout_s=1.0),
+        # probe budget burns FAST so cross-cut evictions land while the
+        # partition is still open (the amnesty path needs someone to
+        # actually be departed when the heal observation arrives)
+        elastic=ElasticConfig(async_aggregation=True, min_received=0.5,
+                              staleness_beta=0.5,
+                              heartbeat_backoff_base_s=0.05,
+                              heartbeat_backoff_max_s=0.2),
+        checkpoint_dir=str(tmp_path / name / "ckpt"),
+        checkpoint_every=1,
+        log_dir=str(tmp_path / name / "logs"),
+        faults=faults,
+    )
+
+
+def test_chaos_end_to_end_split_brain_crash_restart(tmp_path):
+    """The ISSUE's acceptance scenario: an 8-node socket federation is
+    split down the middle for 2+ rounds while one node crashes; both
+    sides keep closing rounds under the async quorum; on heal the
+    amnesty path un-evicts the reachable peers, the crashed node
+    relaunches crash-consistently from its checkpoint, and the run
+    finishes within 5% of a fault-free same-seed twin — with the
+    partition/heal/restart story in the flight recorder and the
+    healthcheck judging the healed federation exit-0."""
+    from p2pfl_tpu.obs import healthcheck
+    from p2pfl_tpu.p2p.launch import run_simulation
+
+    halves = [[0, 1, 2, 3], [4, 5, 6, 7]]
+    faults = [
+        # sorted by (round, node): the cut lands before the crash,
+        # the heal before the restart
+        FaultEvent(node=0, round=1, kind="partition", groups=halves),
+        FaultEvent(node=5, round=1, kind="crash"),
+        FaultEvent(node=0, round=4, kind="heal"),
+        FaultEvent(node=5, round=4, kind="restart"),
+    ]
+    chaos_cfg = _chaos_cfg("chaos-e2e", tmp_path, faults)
+    rec = flight.get_recorder()
+    rec.clear()  # the ring must tell THIS run's story
+
+    out = run_simulation(chaos_cfg, timeout=420)
+
+    # every survivor AND the restarted node ran the full schedule
+    assert out["rounds"] == 6
+    churn = out["churn"]
+    assert churn["partitions"] >= 1 and churn["heals"] >= 1
+    assert churn["crashes"] == [5]
+    assert churn["restarted"] == [5]
+    assert churn.get("recovery_s", 0) > 0  # heal -> first merged round
+
+    # the flight recorder carries the whole fault story
+    evts = rec.events()
+    kinds = {e["kind"] for e in evts}
+    assert "node.partition" in kinds and "node.heal" in kinds
+    # causal, not timing-bound: whenever an eviction landed BEFORE the
+    # heal (the split-brain dead end), the heal must have granted
+    # amnesty — if the schedule raced and nobody was departed yet,
+    # there was nothing to forgive and the claim is vacuous
+    heal_at = next(i for i, e in enumerate(evts)
+                   if e["kind"] == "node.heal")
+    if any(e["kind"] == "membership.evict" for e in evts[:heal_at]):
+        assert "membership.amnesty" in kinds
+    assert "checkpoint.node_save" in kinds  # periodic checkpoints ran
+    # the relaunch took the resume path (own checkpoint when one was
+    # cut before the crash, loud fallback otherwise)
+    assert kinds & {"checkpoint.resume", "checkpoint.resume_missing",
+                    "checkpoint.resume_decision"}
+
+    # healthcheck over the published status records: the healed
+    # federation judges clean (exit 0). Nodes finish minutes apart
+    # under chaos, so judge the finished run's corpse with a liveness
+    # window spanning the whole run — the CLI's --liveness-s knob for
+    # exactly this postmortem case; every OTHER rule (stall, partition,
+    # byte-rate, divergence) runs at its defaults
+    status_dir = (pathlib.Path(chaos_cfg.log_dir) / chaos_cfg.name
+                  / "status")
+    assert status_dir.is_dir()
+    eng = HealthEngine(config=HealthConfig(liveness_s=600.0))
+    assert healthcheck.run_once(str(status_dir), eng, False) == 0
+
+    # fault-free twin, same seed/config: accuracy parity within 5%
+    clean = run_simulation(_chaos_cfg("chaos-clean", tmp_path, []),
+                           timeout=300)
+    assert clean["rounds"] == 6
+    assert out["mean_accuracy"] is not None
+    assert clean["mean_accuracy"] is not None
+    assert clean["mean_accuracy"] > 0.4  # the twin actually learned
+    assert out["mean_accuracy"] >= clean["mean_accuracy"] - 0.05

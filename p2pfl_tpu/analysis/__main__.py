@@ -1,0 +1,31 @@
+"""``python -m p2pfl_tpu.analysis`` — run every static pass.
+
+Currently two passes, run in order with the combined exit code being
+the max (healthcheck-style: 0 clean, 1 findings, 2 operational error):
+
+1. **fedlint** over the given paths (default ``p2pfl_tpu/``);
+2. **bench-keys** three-way sync (registry vs docs/perf.md vs the
+   regression gate's HEADLINE keys).
+
+Extra CLI flags are forwarded to fedlint (``--json`` etc. apply to the
+lint pass only; bench-keys keeps its one-line text contract).
+"""
+
+from __future__ import annotations
+
+import sys
+
+from p2pfl_tpu.analysis import benchkeys, fedlint
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    print("== fedlint ==")
+    lint_rc = fedlint.main(argv)
+    print("== bench-keys ==")
+    bench_rc = benchkeys.main()
+    return max(lint_rc, bench_rc)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

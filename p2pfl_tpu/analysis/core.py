@@ -1,0 +1,259 @@
+"""fedlint engine: findings, pragmas, baseline, file runner.
+
+The engine is deliberately dumb and deterministic — parse each file
+once with :mod:`ast`, hand the tree to every rule, subtract per-line
+pragma suppressions and the checked-in baseline, report the rest. No
+imports of the analyzed code, no type inference, no cross-file state:
+a rule must be cheap enough to gate every PR from tier-1 and
+predictable enough that a pragma or baseline entry is a reviewed
+decision, not a dice roll.
+
+Baseline entries are matched by **fingerprint** ``(rule, path,
+stripped source line)`` — line numbers drift with every edit, the
+offending line's text does not. A baseline entry whose line was fixed
+or deleted therefore goes stale automatically and is reported (without
+affecting the exit code) so the file shrinks over time instead of
+accreting.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import pathlib
+import re
+from typing import Any, Iterable, Iterator
+
+#: checked-in grandfather file at the repo root
+BASELINE_NAME = "FEDLINT_BASELINE.json"
+
+_PRAGMA_RE = re.compile(
+    r"#\s*fedlint:\s*disable(?:=(?P<rules>[\w-]+(?:\s*,\s*[\w-]+)*))?"
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One rule violation at one source location."""
+
+    rule: str
+    path: str  # repo-relative posix path
+    line: int
+    col: int
+    message: str
+    code: str  # stripped source line the finding anchors to
+
+    def fingerprint(self) -> tuple[str, str, str]:
+        """Line-number-free identity used for baseline matching."""
+        return (self.rule, self.path, self.code)
+
+    def as_dict(self) -> dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    def render(self) -> str:
+        return (f"{self.path}:{self.line}:{self.col}: {self.rule}: "
+                f"{self.message}")
+
+
+class FileContext:
+    """Everything a rule gets to look at for one file: the parsed
+    tree (with parent links), the raw source, and the line table."""
+
+    def __init__(self, path: pathlib.Path, rel: str, src: str):
+        self.path = path
+        self.rel = rel
+        self.src = src
+        self.lines = src.splitlines()
+        self.tree = ast.parse(src, filename=str(path))
+        for node in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(node):
+                child._fedlint_parent = node  # type: ignore[attr-defined]
+
+    # -- helpers every rule uses ---------------------------------------
+    def parents(self, node: ast.AST) -> Iterator[ast.AST]:
+        """Ancestors of ``node``, nearest first."""
+        cur = getattr(node, "_fedlint_parent", None)
+        while cur is not None:
+            yield cur
+            cur = getattr(cur, "_fedlint_parent", None)
+
+    def segment(self, node: ast.AST) -> str:
+        """Source text of a node ('' when unavailable)."""
+        try:
+            return ast.get_source_segment(self.src, node) or ""
+        except Exception:
+            return ""
+
+    def code_line(self, line: int) -> str:
+        if 1 <= line <= len(self.lines):
+            return self.lines[line - 1].strip()
+        return ""
+
+    def finding(self, rule: str, node: ast.AST, message: str) -> Finding:
+        line = getattr(node, "lineno", 1)
+        return Finding(
+            rule=rule, path=self.rel, line=line,
+            col=getattr(node, "col_offset", 0) + 1,
+            message=message, code=self.code_line(line),
+        )
+
+
+# ---------------------------------------------------------------------
+# pragma suppression
+# ---------------------------------------------------------------------
+
+def pragma_map(lines: list[str]) -> dict[int, set[str] | None]:
+    """Per-line suppression: line number -> set of rule names, or
+    ``None`` meaning every rule (a bare ``# fedlint: disable``)."""
+    out: dict[int, set[str] | None] = {}
+    for i, line in enumerate(lines, start=1):
+        if "fedlint" not in line:
+            continue
+        m = _PRAGMA_RE.search(line)
+        if m is None:
+            continue
+        rules = m.group("rules")
+        if rules is None:
+            out[i] = None
+        else:
+            out[i] = {r.strip() for r in rules.split(",")}
+    return out
+
+
+def suppressed(finding: Finding, pragmas: dict[int, set[str] | None]) -> bool:
+    entry = pragmas.get(finding.line, ...)
+    if entry is ...:
+        return False
+    return entry is None or finding.rule in entry
+
+
+# ---------------------------------------------------------------------
+# baseline
+# ---------------------------------------------------------------------
+
+def load_baseline(path: str | pathlib.Path) -> list[dict[str, Any]]:
+    """Baseline entries (``[]`` when the file doesn't exist). Every
+    entry must carry ``rule``/``path``/``code`` plus a one-line
+    ``justification`` — an unjustified grandfather is refused loudly."""
+    path = pathlib.Path(path)
+    if not path.is_file():
+        return []
+    doc = json.loads(path.read_text())
+    entries = doc.get("entries", []) if isinstance(doc, dict) else doc
+    for e in entries:
+        missing = {"rule", "path", "code", "justification"} - set(e)
+        if missing:
+            raise ValueError(
+                f"baseline {path}: entry {e!r} lacks {sorted(missing)}")
+        if not str(e["justification"]).strip():
+            raise ValueError(
+                f"baseline {path}: entry for {e['path']} ({e['rule']}) "
+                "has an empty justification")
+    return entries
+
+
+def write_baseline(path: str | pathlib.Path, findings: Iterable[Finding],
+                   justification: str = "TODO: justify or fix") -> None:
+    """Regenerate the baseline from current findings (``--write-
+    baseline``). Justifications default to a marker the reviewer must
+    replace — ``load_baseline`` accepts them (non-empty) but the PR
+    diff makes every new grandfather explicit."""
+    entries = [
+        {"rule": f.rule, "path": f.path, "code": f.code,
+         "justification": justification}
+        for f in sorted(set(findings),
+                        key=lambda f: (f.path, f.line, f.rule))
+    ]
+    doc = {"version": 1, "entries": entries}
+    pathlib.Path(path).write_text(json.dumps(doc, indent=1) + "\n")
+
+
+def baseline_index(entries: list[dict[str, Any]]) -> set[tuple[str, str, str]]:
+    return {(e["rule"], e["path"], e["code"]) for e in entries}
+
+
+# ---------------------------------------------------------------------
+# runner
+# ---------------------------------------------------------------------
+
+def iter_py_files(paths: Iterable[str | pathlib.Path]) -> Iterator[pathlib.Path]:
+    for p in paths:
+        p = pathlib.Path(p)
+        if not p.exists():
+            # a missing path must be loud, not a 0-file clean pass
+            raise FileNotFoundError(str(p))
+        if p.is_dir():
+            for f in sorted(p.rglob("*.py")):
+                if any(part.startswith(".") for part in f.parts):
+                    continue
+                yield f
+        elif p.suffix == ".py":
+            yield p
+
+
+@dataclasses.dataclass
+class LintResult:
+    """One run's outcome, pre-split by disposition."""
+
+    findings: list[Finding]          # unsuppressed — these gate
+    pragma_suppressed: list[Finding]
+    baselined: list[Finding]
+    stale_baseline: list[dict[str, Any]]  # entries matching nothing
+    files: int = 0
+
+    @property
+    def exit_code(self) -> int:
+        return 1 if self.findings else 0
+
+    def as_dict(self) -> dict[str, Any]:
+        return {
+            "findings": [f.as_dict() for f in self.findings],
+            "pragma_suppressed": [f.as_dict()
+                                  for f in self.pragma_suppressed],
+            "baselined": [f.as_dict() for f in self.baselined],
+            "stale_baseline": list(self.stale_baseline),
+            "files": self.files,
+            "exit_code": self.exit_code,
+        }
+
+
+def run_paths(paths: Iterable[str | pathlib.Path], rules,
+              root: str | pathlib.Path | None = None,
+              baseline_entries: list[dict[str, Any]] | None = None,
+              ) -> LintResult:
+    """Run ``rules`` over every ``*.py`` under ``paths``.
+
+    ``root`` anchors the repo-relative paths findings (and baseline
+    fingerprints) use; defaults to the common current directory.
+    Raises ``SyntaxError`` for an unparseable file — the CLI maps that
+    to exit code 2 (operational error), never a silent skip.
+    """
+    root = pathlib.Path(root) if root is not None else pathlib.Path.cwd()
+    entries = baseline_entries or []
+    index = baseline_index(entries)
+    res = LintResult([], [], [], [])
+    matched: set[tuple[str, str, str]] = set()
+    for path in iter_py_files(paths):
+        try:
+            rel = path.resolve().relative_to(root.resolve()).as_posix()
+        except ValueError:
+            rel = path.as_posix()
+        ctx = FileContext(path, rel, path.read_text())
+        res.files += 1
+        pragmas = pragma_map(ctx.lines)
+        for rule in rules:
+            for finding in rule.check(ctx):
+                if suppressed(finding, pragmas):
+                    res.pragma_suppressed.append(finding)
+                elif finding.fingerprint() in index:
+                    matched.add(finding.fingerprint())
+                    res.baselined.append(finding)
+                else:
+                    res.findings.append(finding)
+    res.stale_baseline = [
+        e for e in entries
+        if (e["rule"], e["path"], e["code"]) not in matched
+    ]
+    res.findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return res

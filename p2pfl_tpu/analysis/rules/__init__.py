@@ -1,0 +1,25 @@
+"""fedlint rule registry.
+
+Each rule module exports one rule object with ``name``, ``incident``
+(the real defect it encodes — see docs/analysis.md for the catalog)
+and ``check(ctx) -> Iterator[Finding]``. Order is presentation-only;
+findings are re-sorted by location.
+"""
+
+from p2pfl_tpu.analysis.rules.artifacts import ATOMIC_ARTIFACT
+from p2pfl_tpu.analysis.rules.asynchrony import ASYNC_HYGIENE
+from p2pfl_tpu.analysis.rules.donation import DONATION_SAFETY
+from p2pfl_tpu.analysis.rules.jit_purity import JIT_PURITY
+from p2pfl_tpu.analysis.rules.recompile import RECOMPILE_HAZARD
+
+ALL_RULES = (
+    DONATION_SAFETY,
+    RECOMPILE_HAZARD,
+    ASYNC_HYGIENE,
+    JIT_PURITY,
+    ATOMIC_ARTIFACT,
+)
+
+RULES_BY_NAME = {r.name: r for r in ALL_RULES}
+
+__all__ = ["ALL_RULES", "RULES_BY_NAME"]

@@ -1,0 +1,106 @@
+"""Small AST helpers shared by the fedlint rules."""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Callable, Iterator
+
+FUNC_DEFS = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    """One fedlint rule: a name, the incident it encodes, and a
+    checker run once per file."""
+
+    name: str
+    incident: str
+    check: Callable  # (FileContext) -> Iterator[Finding]
+
+
+def dotted_name(node: ast.AST) -> str:
+    """``a.b.c`` for Name/Attribute chains, '' otherwise."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def tail_name(node: ast.AST) -> str:
+    """The last component of a call target: ``flax_ser.msgpack_restore``
+    -> ``msgpack_restore``; plain names pass through."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return ""
+
+
+def enclosing_function(ctx, node: ast.AST):
+    """Nearest enclosing (Async)FunctionDef, or None at module scope."""
+    for parent in ctx.parents(node):
+        if isinstance(parent, FUNC_DEFS):
+            return parent
+    return None
+
+
+def inside_loop(ctx, node: ast.AST, stop_at: ast.AST | None = None) -> bool:
+    """True when ``node`` sits inside a for/while body (not crossing
+    a nested function boundary; ``stop_at`` bounds the walk)."""
+    for parent in ctx.parents(node):
+        if parent is stop_at or isinstance(parent, FUNC_DEFS):
+            return False
+        if isinstance(parent, (ast.For, ast.AsyncFor, ast.While)):
+            return True
+    return False
+
+
+def walk_function_body(fn: ast.AST,
+                       skip_nested: bool = True) -> Iterator[ast.AST]:
+    """Walk a function's body; ``skip_nested`` stops at nested
+    function/lambda boundaries (they get their own visit from the
+    module walk, or deliberately stay out of scope)."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        yield node
+        if skip_nested and isinstance(node, (*FUNC_DEFS, ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def int_constants(node: ast.AST) -> list[int]:
+    """Integer constants inside a Constant/Tuple/List node (the shape
+    ``donate_argnums`` values take)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out: list[int] = []
+        for elt in node.elts:
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, int):
+                out.append(elt.value)
+        return out
+    return []
+
+
+def local_names(fn: ast.AST) -> set[str]:
+    """Names bound inside a function: parameters plus every plain-Name
+    store target (assignments, loop targets, comprehension targets,
+    ``with ... as``)."""
+    out: set[str] = set()
+    args = fn.args
+    for a in (*args.posonlyargs, *args.args, *args.kwonlyargs):
+        out.add(a.arg)
+    if args.vararg:
+        out.add(args.vararg.arg)
+    if args.kwarg:
+        out.add(args.kwarg.arg)
+    for node in walk_function_body(fn, skip_nested=False):
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Store):
+            out.add(node.id)
+    return out

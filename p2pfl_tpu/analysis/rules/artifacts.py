@@ -1,0 +1,109 @@
+"""atomic-artifact — the round-12/14 torn-read contracts.
+
+Every artifact another process tails live (status JSON, checkpoints,
+flight recordings, metrics, bench results, health probes) must be
+published atomically: write to a ``tmp`` sibling, fsync, then
+``os.replace`` — or append exactly one complete ``write()`` per record
+to an ``"a"``-mode stream. A plain ``write_text``/``open(..., "w")``
+straight onto the published path gives a tailer a window where the
+file is empty or half-written; round-12 (metrics.jsonl) and round-14
+(checkpoint manifests) both shipped fixes for exactly that.
+
+Scoping keeps this precise: only write-sites whose source text looks
+like a published artifact (status/checkpoint/flight/metrics/trace/
+bench/health or a ``.json``/``.jsonl`` suffix) are candidates; writes
+mentioning ``tmp`` and writes inside functions that also call
+``os.replace``/``rename`` (i.e. the atomic pattern itself) are exempt,
+as is append mode.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from p2pfl_tpu.analysis.rules._util import (
+    FUNC_DEFS,
+    Rule,
+    enclosing_function,
+    tail_name,
+)
+
+NAME = "atomic-artifact"
+
+_ARTIFACT_MARKERS = ("status", "checkpoint", "flight", "metrics", "trace",
+                     "bench", "health", ".json", ".jsonl")
+_WRITE_TAILS = {"write_text", "write_bytes"}
+
+
+def _artifact_segment(seg: str) -> bool:
+    low = seg.lower()
+    if "tmp" in low:
+        return False
+    return any(marker in low for marker in _ARTIFACT_MARKERS)
+
+
+def _scope_has_replace(ctx, node: ast.AST) -> bool:
+    scope = enclosing_function(ctx, node) or ctx.tree
+    for sub in ast.walk(scope):
+        if (isinstance(sub, ast.Call)
+                and tail_name(sub.func) in {"replace", "rename"}):
+            return True
+    return False
+
+
+def _open_write_mode(call: ast.Call) -> str | None:
+    """The mode of an ``open``-family call when it writes ('' when the
+    call reads or the mode is dynamic)."""
+    if tail_name(call.func) != "open":
+        return None
+    mode = None
+    if len(call.args) >= 2:
+        mode = call.args[1]
+    for kw in call.keywords:
+        if kw.arg == "mode":
+            mode = kw.value
+    if mode is None:
+        return None  # default 'r'
+    if isinstance(mode, ast.Constant) and isinstance(mode.value, str):
+        return mode.value if any(c in mode.value for c in "wax") else None
+    return None
+
+
+def _check(ctx) -> Iterator:
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        tail = tail_name(node.func)
+        if tail in _WRITE_TAILS and isinstance(node.func, ast.Attribute):
+            seg = ctx.segment(node)
+            if _artifact_segment(seg) and not _scope_has_replace(ctx, node):
+                yield ctx.finding(
+                    NAME, node,
+                    f"'.{tail}()' publishes an artifact in place — a "
+                    "live tailer can see it empty or torn; write to a "
+                    "tmp sibling, fsync, then os.replace (cf. "
+                    "checkpoint._atomic_write_bytes)")
+        else:
+            mode = _open_write_mode(node)
+            if mode is None or "a" in mode:
+                continue  # reads and appends are fine
+            seg = ctx.segment(node)
+            if _artifact_segment(seg) and not _scope_has_replace(ctx, node):
+                yield ctx.finding(
+                    NAME, node,
+                    f"open(..., {mode!r}) truncates a published "
+                    "artifact in place — a live tailer can see it "
+                    "empty or torn; write to a tmp sibling, fsync, "
+                    "then os.replace, or append complete records in "
+                    "'a' mode")
+
+
+ATOMIC_ARTIFACT = Rule(
+    name=NAME,
+    incident=("round-12/round-14: live tailers (dashboard, resume) read "
+              "half-written metrics.jsonl lines and checkpoint "
+              "manifests; the fix was tmp+fsync+os.replace and "
+              "single-write append contracts"),
+    check=_check,
+)

@@ -1,0 +1,90 @@
+"""async-hygiene — the round-11 prober class.
+
+Two failure modes from the same incident family:
+
+1. **blocking call on the event loop**: the round-11 prober killed
+   healthy lanes because CPU/IO-bound work inside ``async def`` starved
+   the heartbeat coroutines past their eviction deadline. Flagged:
+   ``time.sleep``, synchronous file IO (``open``,
+   ``Path.read_text``/``write_text``/``read_bytes``/``write_bytes``),
+   ``subprocess.run``, and blocking ``Future.result()``. Use
+   ``await asyncio.sleep``, ``run_in_executor``, or move the work to a
+   worker thread.
+2. **fire-and-forget task**: a bare ``asyncio.create_task(...)``
+   statement keeps no reference — the task can be garbage-collected
+   mid-flight, and its exception surfaces only at interpreter exit.
+   Keep a reference and consume the exception in a done-callback (see
+   ``Node._track_task``).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from p2pfl_tpu.analysis.rules._util import (
+    Rule,
+    dotted_name,
+    tail_name,
+    walk_function_body,
+)
+
+NAME = "async-hygiene"
+
+_SYNC_IO_TAILS = {"read_text", "write_text", "read_bytes", "write_bytes"}
+_SPAWN_TAILS = {"create_task", "ensure_future"}
+
+
+def _blocking_reason(call: ast.Call) -> str | None:
+    dn = dotted_name(call.func)
+    tail = tail_name(call.func)
+    if dn == "time.sleep":
+        return "time.sleep blocks the event loop; use await asyncio.sleep"
+    if dn == "open" or dn.endswith("subprocess.run") or dn == "subprocess.run":
+        return (f"'{dn}' is synchronous IO on the event loop; use "
+                "run_in_executor or a worker thread")
+    if tail in _SYNC_IO_TAILS and isinstance(call.func, ast.Attribute):
+        return (f"'.{tail}()' is synchronous file IO on the event loop; "
+                "use run_in_executor or a worker thread")
+    if (tail == "result" and isinstance(call.func, ast.Attribute)
+            and not call.args):
+        return ("'.result()' blocks until the future resolves; await it "
+                "instead")
+    return None
+
+
+def _check(ctx) -> Iterator:
+    for node in ast.walk(ctx.tree):
+        # blocking calls, scoped to the async function's own statements
+        # (nested sync defs run off-loop via executors; nested async
+        # defs get their own visit from this walk)
+        if isinstance(node, ast.AsyncFunctionDef):
+            for sub in walk_function_body(node, skip_nested=True):
+                if isinstance(sub, ast.Call):
+                    reason = _blocking_reason(sub)
+                    if reason is not None:
+                        yield ctx.finding(
+                            NAME, sub,
+                            f"blocking call in async def "
+                            f"'{node.name}': {reason} (the round-11 "
+                            "prober starved heartbeats this way)")
+        # fire-and-forget tasks, anywhere
+        elif (isinstance(node, ast.Expr)
+              and isinstance(node.value, ast.Call)
+              and tail_name(node.value.func) in _SPAWN_TAILS):
+            yield ctx.finding(
+                NAME, node.value,
+                f"fire-and-forget '{tail_name(node.value.func)}': no "
+                "reference is kept, so the task can be GC'd mid-flight "
+                "and its exception is never retrieved; keep a reference "
+                "and consume the exception in a done-callback")
+
+
+ASYNC_HYGIENE = Rule(
+    name=NAME,
+    incident=("round-11: a CPU-bound fit inside an async prober blocked "
+              "the event loop, heartbeats missed their deadline, and "
+              "healthy peers were evicted; fire-and-forget probe tasks "
+              "also swallowed the evidence"),
+    check=_check,
+)

@@ -1,0 +1,319 @@
+"""donation-safety — the round-9 resume bug class.
+
+Two sub-checks:
+
+1. **read-after-donate**: a binding passed at a donated position of a
+   ``jax.jit(..., donate_argnums=...)`` callee is dead after the call;
+   reading it again dereferences a freed device buffer.
+2. **non-owning seed**: leaves produced by ``msgpack_restore`` /
+   ``from_state_dict`` / ``np.frombuffer`` are views of the serialized
+   blob's bytes. Handing them to ``jnp.asarray`` / ``jnp.array``
+   (without ``copy=True``) / ``jax.device_put`` — or straight into a
+   donating callee — can alias host memory the blob owner is free to
+   reuse; the read is heap-layout-dependent garbage. The fix is an
+   owning construction: ``jnp.array(x, copy=True)`` / ``np.array(x)``
+   / ``np.copy(x)``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from p2pfl_tpu.analysis.rules._util import (
+    FUNC_DEFS,
+    Rule,
+    dotted_name,
+    enclosing_function,
+    int_constants,
+    tail_name,
+)
+
+NAME = "donation-safety"
+
+#: calls whose result is a non-owning view of serialized bytes
+_NON_OWNING_PRODUCERS = {"msgpack_restore", "from_state_dict", "frombuffer"}
+
+#: calls that propagate ownership status from arg to result
+_PASSTHROUGH = {"leaves", "tree_leaves", "flatten", "tree_flatten",
+                "list", "tuple", "sorted", "reversed"}
+
+#: device-transfer sinks that may alias a host view (jnp.array is only
+#: a sink without an explicit copy=True)
+_ALIASING_SINKS = {"jnp.asarray", "jax.numpy.asarray", "jax.device_put",
+                   "jnp.array", "jax.numpy.array"}
+
+_OWNING_TAILS = {"copy", "ascontiguousarray"}
+
+
+def _is_owning_construction(call: ast.Call) -> bool:
+    """``np.array(x)`` / ``*.array(x, copy=True)`` / ``np.copy`` /
+    ``jnp.copy`` / ``ascontiguousarray`` — produces an owning buffer."""
+    tail = tail_name(call.func)
+    if tail in _OWNING_TAILS:
+        return True
+    if tail == "array":
+        dn = dotted_name(call.func)
+        if dn.startswith(("np.", "numpy.")):
+            # numpy's default is copy=True; only copy=False opts out
+            for kw in call.keywords:
+                if kw.arg == "copy" and not (
+                        isinstance(kw.value, ast.Constant)
+                        and kw.value.value is True):
+                    return False
+            return True
+        for kw in call.keywords:
+            if (kw.arg == "copy" and isinstance(kw.value, ast.Constant)
+                    and kw.value.value is True):
+                return True
+    return False
+
+
+def _donating_bindings(tree: ast.AST) -> dict[str, set[int]]:
+    """Names bound (by assignment or decorator) to a jit with
+    ``donate_argnums`` -> the set of donated positional indices."""
+    out: dict[str, set[int]] = {}
+
+    def donated(call: ast.Call) -> set[int]:
+        if tail_name(call.func) not in {"jit", "pjit"}:
+            return set()
+        for kw in call.keywords:
+            if kw.arg == "donate_argnums":
+                return set(int_constants(kw.value))
+        return set()
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            idx = donated(node.value)
+            # partial(jax.jit, donate_argnums=...)(fn) style
+            if not idx and isinstance(node.value.func, ast.Call):
+                idx = donated(node.value.func)
+            if idx:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        out[t.id] = idx
+        elif isinstance(node, FUNC_DEFS):
+            for dec in node.decorator_list:
+                if isinstance(dec, ast.Call):
+                    idx = donated(dec)
+                    # @partial(jax.jit, donate_argnums=...)
+                    if not idx and tail_name(dec.func) == "partial" and dec.args:
+                        inner = ast.Call(func=dec.args[0], args=[],
+                                         keywords=dec.keywords)
+                        idx = donated(inner)
+                    if idx:
+                        out[node.name] = idx
+    return out
+
+
+def _name_nodes(fn: ast.AST, ident: str) -> list[ast.Name]:
+    nodes = [n for n in ast.walk(fn)
+             if isinstance(n, ast.Name) and n.id == ident]
+    nodes.sort(key=lambda n: (n.lineno, n.col_offset))
+    return nodes
+
+
+def _check_read_after_donate(ctx, donors: dict[str, set[int]]
+                             ) -> Iterator:
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.Call)
+                and tail_name(node.func) in donors):
+            continue
+        scope = enclosing_function(ctx, node) or ctx.tree
+        for i in donors[tail_name(node.func)]:
+            if not (i < len(node.args)
+                    and isinstance(node.args[i], ast.Name)):
+                continue
+            ident = node.args[i].id
+            # a store on the call's own line (`fed, m = step(fed, ...)`)
+            # rebinds the name at runtime right after the donation
+            stored = False
+            for name in _name_nodes(scope, ident):
+                if name.lineno < node.lineno:
+                    continue
+                owner = enclosing_function(ctx, name) or ctx.tree
+                if owner is not scope:
+                    continue  # a different scope's binding of the name
+                if isinstance(name.ctx, ast.Store):
+                    stored = True
+                elif name.lineno == node.lineno:
+                    continue  # the donated argument itself
+                elif not stored:
+                    yield ctx.finding(
+                        NAME, name,
+                        f"'{ident}' was donated to "
+                        f"'{tail_name(node.func)}' on line "
+                        f"{node.lineno} and must not be read afterwards "
+                        "(the device buffer is freed); rebind the "
+                        "result or pass a copy")
+
+
+class _TaintScan:
+    """Order-sensitive scan of one scope tracking names bound to
+    non-owning (view) buffers."""
+
+    def __init__(self, ctx, donors: dict[str, set[int]]):
+        self.ctx = ctx
+        self.donors = donors
+        self.tainted: set[str] = set()
+        self.findings: list = []
+
+    # -- taint queries -------------------------------------------------
+    def _expr_tainted(self, node: ast.AST, extra: set[str] = frozenset()
+                      ) -> bool:
+        if isinstance(node, ast.Name):
+            return node.id in self.tainted or node.id in extra
+        if isinstance(node, (ast.Subscript, ast.Starred)):
+            return self._expr_tainted(node.value, extra)
+        if isinstance(node, ast.Call):
+            tail = tail_name(node.func)
+            if tail in _NON_OWNING_PRODUCERS:
+                return True
+            if _is_owning_construction(node):
+                return False
+            if tail in _PASSTHROUGH:
+                return any(self._expr_tainted(a, extra) for a in node.args)
+        if isinstance(node, (ast.Tuple, ast.List)):
+            return any(self._expr_tainted(e, extra) for e in node.elts)
+        return False
+
+    # -- stores --------------------------------------------------------
+    def _store(self, target: ast.AST, taint: bool) -> None:
+        if isinstance(target, ast.Name):
+            (self.tainted.add if taint
+             else self.tainted.discard)(target.id)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for e in target.elts:
+                self._store(e, taint)
+
+    def _store_zip(self, target: ast.AST, zip_call: ast.Call) -> None:
+        """``for t, r in zip(a, b)``: taint only the targets aligned
+        with tainted zip arguments — flagging ``t`` too was the false
+        positive that would hit checkpoint's restore loop."""
+        if (isinstance(target, (ast.Tuple, ast.List))
+                and len(target.elts) == len(zip_call.args)):
+            for elt, arg in zip(target.elts, zip_call.args):
+                self._store(elt, self._expr_tainted(arg))
+        else:
+            self._store(target, any(self._expr_tainted(a)
+                                    for a in zip_call.args))
+
+    # -- sinks ---------------------------------------------------------
+    def _scan_expr(self, node: ast.AST | None,
+                   extra: set[str] = frozenset()) -> None:
+        if node is None:
+            return
+        if isinstance(node, (ast.ListComp, ast.SetComp, ast.GeneratorExp,
+                             ast.DictComp)):
+            # comprehension targets inherit taint from their iterables
+            # (with zip positional alignment, as in the For handler)
+            comp_extra = set(extra)
+            for gen in node.generators:
+                it = gen.iter
+                self._scan_expr(it, extra)
+                if (isinstance(it, ast.Call)
+                        and tail_name(it.func) == "zip"
+                        and isinstance(gen.target, ast.Tuple)
+                        and len(gen.target.elts) == len(it.args)):
+                    for elt, arg in zip(gen.target.elts, it.args):
+                        if (isinstance(elt, ast.Name)
+                                and self._expr_tainted(arg, extra)):
+                            comp_extra.add(elt.id)
+                elif (self._expr_tainted(it, extra)
+                      and isinstance(gen.target, ast.Name)):
+                    comp_extra.add(gen.target.id)
+                for cond in gen.ifs:
+                    self._scan_expr(cond, comp_extra)
+            for part in ("elt", "key", "value"):
+                self._scan_expr(getattr(node, part, None), comp_extra)
+            return
+        if isinstance(node, ast.Call):
+            dn = dotted_name(node.func)
+            if dn in _ALIASING_SINKS and not _is_owning_construction(node):
+                for arg in node.args[:1]:
+                    if self._expr_tainted(arg, extra):
+                        self.findings.append(self.ctx.finding(
+                            NAME, node,
+                            f"'{dn}' over a non-owning deserialized "
+                            "buffer may alias freed host memory; build "
+                            "an owning copy first (jnp.array(x, "
+                            "copy=True) / np.array(x))"))
+            tail = tail_name(node.func)
+            if tail in self.donors:
+                for i in self.donors[tail]:
+                    if (i < len(node.args)
+                            and self._expr_tainted(node.args[i], extra)):
+                        self.findings.append(self.ctx.finding(
+                            NAME, node,
+                            f"non-owning deserialized buffer donated to "
+                            f"'{tail}' (donate_argnums={i}); donating a "
+                            "view of the blob bytes is the round-9 "
+                            "garbage-read bug — copy it first"))
+        for child in ast.iter_child_nodes(node):
+            self._scan_expr(child, extra)
+
+    # -- statements ----------------------------------------------------
+    def run(self, body: list[ast.stmt]) -> None:
+        for stmt in body:
+            self._stmt(stmt)
+
+    def _stmt(self, stmt: ast.stmt) -> None:
+        if isinstance(stmt, FUNC_DEFS + (ast.ClassDef,)):
+            return  # nested scopes scanned on their own
+        if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            value = stmt.value
+            if value is None:
+                return
+            self._scan_expr(value)
+            taint = self._expr_tainted(value)
+            targets = (stmt.targets if isinstance(stmt, ast.Assign)
+                       else [stmt.target])
+            for t in targets:
+                self._store(t, taint)
+        elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self._scan_expr(stmt.iter)
+            it = stmt.iter
+            if isinstance(it, ast.Call) and tail_name(it.func) == "zip":
+                self._store_zip(stmt.target, it)
+            else:
+                self._store(stmt.target, self._expr_tainted(it))
+            self.run(stmt.body)
+            self.run(stmt.orelse)
+        elif isinstance(stmt, (ast.If, ast.While)):
+            self._scan_expr(stmt.test)
+            self.run(stmt.body)
+            self.run(stmt.orelse)
+        elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                self._scan_expr(item.context_expr)
+            self.run(stmt.body)
+        elif isinstance(stmt, ast.Try):
+            self.run(stmt.body)
+            for h in stmt.handlers:
+                self.run(h.body)
+            self.run(stmt.orelse)
+            self.run(stmt.finalbody)
+        else:
+            for child in ast.iter_child_nodes(stmt):
+                if isinstance(child, ast.expr):
+                    self._scan_expr(child)
+
+
+def _check(ctx) -> Iterator:
+    donors = _donating_bindings(ctx.tree)
+    yield from _check_read_after_donate(ctx, donors)
+    scopes = [ctx.tree] + [n for n in ast.walk(ctx.tree)
+                           if isinstance(n, FUNC_DEFS)]
+    for scope in scopes:
+        scan = _TaintScan(ctx, donors)
+        scan.run(scope.body)
+        yield from scan.findings
+
+
+DONATION_SAFETY = Rule(
+    name=NAME,
+    incident=("round-9: msgpack-restored leaves (non-owning views of the "
+              "checkpoint blob) were handed to a donate_argnums callee — "
+              "a heap-layout-dependent garbage read on resume"),
+    check=_check,
+)

@@ -1,0 +1,131 @@
+"""jit-purity — host side effects inside traced functions.
+
+A function handed to ``jax.jit`` / ``lax.scan`` / ``shard_map`` is
+traced once and replayed as XLA; host-side work inside it either fails
+at trace time (``np.asarray`` on a tracer) or silently runs exactly
+once and never again (``print``, counter bumps, attr mutation). The
+rule finds functions that are jit-compiled — by decorator or by being
+passed to a tracing entry point — and flags:
+
+- ``print(...)`` (use ``jax.debug.print`` for traced values),
+- NumPy host-transfer calls (``np.asarray``/``np.array``/``np.save``/
+  ...) which force a device sync or fail on tracers,
+- tracer/flight counter calls (``.count``/``.high_water``/``.span``),
+- mutation of non-local state (attribute stores, subscript stores to
+  names not bound in the function — Pallas ``o_ref[...] = x`` stays
+  clean because refs are parameters).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from p2pfl_tpu.analysis.rules._util import (
+    FUNC_DEFS,
+    Rule,
+    dotted_name,
+    local_names,
+    tail_name,
+    walk_function_body,
+)
+
+NAME = "jit-purity"
+
+_TRACE_ENTRY_TAILS = {"jit", "pjit", "shard_map", "scan", "vmap", "pmap",
+                      "fori_loop", "while_loop"}
+_NP_HOST_TAILS = {"asarray", "array", "copy", "save", "load", "frombuffer",
+                  "savez"}
+_COUNTER_TAILS = {"count", "high_water", "span"}
+
+
+def _decorator_traces(dec: ast.AST) -> bool:
+    if isinstance(dec, (ast.Name, ast.Attribute)):
+        return tail_name(dec) in {"jit", "pjit", "shard_map"}
+    if isinstance(dec, ast.Call):
+        if tail_name(dec.func) in {"jit", "pjit", "shard_map"}:
+            return True
+        if tail_name(dec.func) == "partial" and dec.args:
+            return tail_name(dec.args[0]) in {"jit", "pjit", "shard_map"}
+    return False
+
+
+def _jitted_functions(ctx) -> list[ast.AST]:
+    by_name: dict[str, ast.AST] = {}
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, FUNC_DEFS):
+            by_name[node.name] = node
+    traced: dict[int, ast.AST] = {}
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, FUNC_DEFS):
+            if any(_decorator_traces(d) for d in node.decorator_list):
+                traced[id(node)] = node
+        elif isinstance(node, ast.Call):
+            tail = tail_name(node.func)
+            if tail not in _TRACE_ENTRY_TAILS:
+                continue
+            # `scan` etc. must come off lax/jax to count
+            dn = dotted_name(node.func)
+            if tail in {"scan", "fori_loop", "while_loop"} and not (
+                    "lax" in dn.split(".")):
+                continue
+            for arg in (*node.args, *(kw.value for kw in node.keywords)):
+                if isinstance(arg, ast.Name) and arg.id in by_name:
+                    fn = by_name[arg.id]
+                    traced[id(fn)] = fn
+    return list(traced.values())
+
+
+def _impurity(node: ast.AST, locals_: set[str]) -> str | None:
+    if isinstance(node, ast.Call):
+        dn = dotted_name(node.func)
+        if dn == "print":
+            return ("print() runs once at trace time, never per step; "
+                    "use jax.debug.print")
+        if (dn.startswith(("np.", "numpy."))
+                and tail_name(node.func) in _NP_HOST_TAILS):
+            return (f"'{dn}' forces a host transfer (or fails on a "
+                    "tracer); stay in jnp inside traced code")
+        if (isinstance(node.func, ast.Attribute)
+                and node.func.attr in _COUNTER_TAILS
+                and not dn.startswith(("jnp.", "jax.", "lax."))):
+            return (f"tracer call '.{node.func.attr}()' fires once at "
+                    "trace, not per execution; record metrics outside "
+                    "the jitted function")
+    elif isinstance(node, (ast.Assign, ast.AugAssign)):
+        targets = (node.targets if isinstance(node, ast.Assign)
+                   else [node.target])
+        for t in targets:
+            if isinstance(t, ast.Attribute):
+                return ("attribute mutation inside a traced function "
+                        "happens once at trace time and is invisible "
+                        "to later calls")
+            if (isinstance(t, ast.Subscript)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id not in locals_):
+                return (f"subscript store to non-local "
+                        f"'{t.value.id}' inside a traced function "
+                        "mutates host state at trace time only; return "
+                        "the value instead")
+    return None
+
+
+def _check(ctx) -> Iterator:
+    for fn in _jitted_functions(ctx):
+        locals_ = local_names(fn)
+        for node in walk_function_body(fn, skip_nested=True):
+            reason = _impurity(node, locals_)
+            if reason is not None:
+                yield ctx.finding(
+                    NAME, node,
+                    f"host side effect in jit-compiled "
+                    f"'{fn.name}': {reason}")
+
+
+JIT_PURITY = Rule(
+    name=NAME,
+    incident=("host side effects inside jitted/scanned functions either "
+              "fail at trace time or silently run once at trace and "
+              "never again — metrics recorded this way read as frozen"),
+    check=_check,
+)

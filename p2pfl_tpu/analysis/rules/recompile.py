@@ -1,0 +1,107 @@
+"""recompile-hazard — the §7b storm class.
+
+The §7b postmortem counted ~450 mid-round XLA compiles (~32% of round
+wall time). Three mechanical signatures cover what actually happened:
+
+1. **shape-varying stack in a loop**: ``jnp.stack``/``jnp.concatenate``
+   over a Python list inside a per-round/per-message loop retraces XLA
+   once per distinct list length. Hoist to a fixed-width buffer or pad
+   to a bucketed shape (see ``p2p/session.py``'s ``tree_stack``, which
+   runs once per aggregation, not per message).
+2. **jit in a loop**: calling ``jax.jit(...)`` inside a for/while body
+   builds a fresh callable per iteration — every call is a cache miss.
+   Bind the jitted function once, outside the loop.
+3. **ungated f-string counter key**: ``count(f"...{x}")`` /
+   ``high_water(f"...")`` allocates a fresh key string per frame even
+   when tracing is off. Hot paths must gate under
+   ``if tracer.enabled:`` so the disabled path is allocation-free.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from p2pfl_tpu.analysis.rules._util import (
+    Rule,
+    dotted_name,
+    inside_loop,
+    tail_name,
+)
+
+NAME = "recompile-hazard"
+
+_STACK_TAILS = {"stack", "vstack", "hstack", "concatenate"}
+_JNP_BASES = ("jnp.", "jax.numpy.")
+_COUNTER_TAILS = {"count", "high_water"}
+
+
+def _is_jnp(func: ast.AST) -> bool:
+    dn = dotted_name(func)
+    return dn.startswith(_JNP_BASES)
+
+
+def _enabled_gated(ctx, node: ast.AST) -> bool:
+    """True when ``node`` sits under an ``if <tracer>.enabled:`` (or
+    equivalent) guard."""
+    for parent in ctx.parents(node):
+        if isinstance(parent, (ast.If, ast.IfExp)):
+            for sub in ast.walk(parent.test):
+                if isinstance(sub, ast.Attribute) and sub.attr == "enabled":
+                    return True
+                if isinstance(sub, ast.Name) and sub.id == "enabled":
+                    return True
+        if isinstance(parent, ast.BoolOp):
+            # `tr.enabled and tr.count(...)` short-circuit style
+            for sub in ast.walk(parent):
+                if isinstance(sub, ast.Attribute) and sub.attr == "enabled":
+                    return True
+    return False
+
+
+def _has_dynamic_fstring(call: ast.Call) -> bool:
+    for arg in call.args:
+        if isinstance(arg, ast.JoinedStr) and any(
+                isinstance(v, ast.FormattedValue) for v in arg.values):
+            return True
+    return False
+
+
+def _check(ctx) -> Iterator:
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        tail = tail_name(node.func)
+        if (tail in _STACK_TAILS and _is_jnp(node.func)
+                and inside_loop(ctx, node)):
+            yield ctx.finding(
+                NAME, node,
+                f"'{dotted_name(node.func)}' inside a loop retraces XLA "
+                "once per distinct input length (the §7b storm); hoist "
+                "out of the loop or pad to a bucketed shape")
+        elif (tail in {"jit", "pjit"}
+              and dotted_name(node.func) in {"jit", "pjit", "jax.jit",
+                                             "jax.pjit"}
+              and inside_loop(ctx, node)):
+            yield ctx.finding(
+                NAME, node,
+                "jax.jit called inside a loop builds a fresh callable "
+                "per iteration — every call misses the compile cache; "
+                "bind the jitted function once outside the loop")
+        elif (tail in _COUNTER_TAILS and _has_dynamic_fstring(node)
+              and not _enabled_gated(ctx, node)):
+            yield ctx.finding(
+                NAME, node,
+                f"f-string key for '{tail}' allocates per call even "
+                "with tracing off; gate the call under "
+                "'if tracer.enabled:' so the disabled path is "
+                "allocation-free")
+
+
+RECOMPILE_HAZARD = Rule(
+    name=NAME,
+    incident=("§7b: ~450 mid-round XLA compiles (~32% of wall) from "
+              "shape-varying stacks in the socket hot path, plus "
+              "per-frame f-string counter keys when tracing was off"),
+    check=_check,
+)

@@ -136,11 +136,15 @@ class Scenario(Observable):
                 pass
             try:
                 # 3-D/geo topology export for the dashboard map
-                # (topologymanager.py:151-173 + 320-355)
+                # (topologymanager.py:151-173 + 320-355) — atomic: the
+                # webapp map tails this file while the run is live
                 import json as _json
 
-                (self.logger.dir / "topology_3d.json").write_text(
-                    _json.dumps(self.topology.to_3d(seed=config.seed))
+                from p2pfl_tpu.utils.fsio import atomic_write_text
+
+                atomic_write_text(
+                    self.logger.dir / "topology_3d.json",
+                    _json.dumps(self.topology.to_3d(seed=config.seed)),
                 )
             except Exception:
                 pass

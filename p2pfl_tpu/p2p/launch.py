@@ -657,8 +657,16 @@ def run_simulation(cfg: ScenarioConfig, timeout: float = 600) -> dict:
     SURVEY §4: same code path, loopback TCP, no cluster). One
     ``SharedTrainer`` serves every node, so the model compiles once
     instead of ``n_nodes`` times. Returns wall-clock and per-round
-    timing plus the federation's mean final accuracy."""
-    return asyncio.run(_simulate(cfg, timeout))
+    timing plus the federation's mean final accuracy.
+
+    Under ``P2PFL_SANITIZE=1`` the run executes with jax_debug_nans,
+    asyncio debug mode, and leaked-resource/never-awaited warnings
+    promoted to errors (utils/sanitize.py)."""
+    from p2pfl_tpu.utils import sanitize
+
+    with sanitize.scope():
+        return asyncio.run(_simulate(cfg, timeout),
+                           debug=sanitize.asyncio_debug())
 
 
 def launch(cfg: ScenarioConfig, config_path: str | pathlib.Path,

@@ -330,6 +330,35 @@ class P2PNode:
     # ------------------------------------------------------------------
     # lifecycle
     # ------------------------------------------------------------------
+    def _track_task(self, coro, what: str) -> asyncio.Task:
+        """Spawn ``coro`` as a tracked, exception-consuming task.
+
+        A bare ``asyncio.create_task`` keeps no reference — the task
+        can be garbage-collected mid-flight and a failure surfaces only
+        as "exception was never retrieved" at interpreter exit (the
+        round-11 prober class). Tracking in ``self._tasks`` pins the
+        task and lets ``stop()`` cancel it; the done-callback prunes
+        the list on completion (so reconnect churn doesn't accumulate
+        dead tasks) and logs any exception instead of swallowing it.
+        """
+        task = asyncio.create_task(coro)
+        self._tasks.append(task)
+
+        def _done(t: asyncio.Task) -> None:
+            if t in self._tasks:
+                self._tasks.remove(t)
+            if t.cancelled():
+                return
+            exc = t.exception()
+            if exc is not None:
+                log.error("node %d background task %r failed: %r",
+                          self.idx, what, exc)
+                flight.record("node.task_failed", node=self.idx,
+                              what=what, error=repr(exc)[:200])
+
+        task.add_done_callback(_done)
+        return task
+
     async def start(self) -> None:
         self._server = await asyncio.start_server(
             self._on_connection, self.host, self.port,
@@ -342,7 +371,7 @@ class P2PNode:
             self.shaper.start_clock()
         if self.resume and self.checkpoint_dir:
             self._try_resume()
-        self._tasks.append(asyncio.create_task(self._heartbeat_loop()))
+        self._track_task(self._heartbeat_loop(), "heartbeat_loop")
 
     def _try_resume(self) -> None:
         """Crash-consistent restart (round 14): adopt this node's own
@@ -621,11 +650,7 @@ class P2PNode:
         # late joiner adopts the converged model, fast-forwards past
         # the whole schedule, and terminates immediately.
         if self.initialized and (self.learning or self.finished.is_set()):
-            task = asyncio.create_task(self._send_state_sync(peer))
-            self._tasks.append(task)
-            task.add_done_callback(
-                lambda t: self._tasks.remove(t) if t in self._tasks else None
-            )
+            self._track_task(self._send_state_sync(peer), "state_sync")
 
     async def _send_state_sync(self, peer: PeerState) -> None:
         """Answer a joiner's hello with the current global model in
@@ -681,13 +706,8 @@ class P2PNode:
         self.peers[idx] = peer
         self.membership.beat(idx)
         # tracked: protects against task GC and lets stop() cancel a
-        # sync still draining a large init-weights write; pruned on
-        # completion so reconnect churn doesn't accumulate dead tasks
-        task = asyncio.create_task(self._sync_peer(peer))
-        self._tasks.append(task)
-        task.add_done_callback(
-            lambda t: self._tasks.remove(t) if t in self._tasks else None
-        )
+        # sync still draining a large init-weights write
+        self._track_task(self._sync_peer(peer), "sync_peer")
         return peer
 
     async def _sync_peer(self, peer: PeerState) -> None:
@@ -962,7 +982,8 @@ class P2PNode:
                 # topologies (ring/random) the starter only reaches its
                 # direct neighbors, so every receiver re-diffuses
                 # (node.py:702-724 diffusion-until-initialized)
-                asyncio.create_task(self._diffuse_initial())
+                self._track_task(self._diffuse_initial(),
+                                 "diffuse_initial")
             return
         if self.role == "proxy" and msg.msg_id:
             # PROXY: relay weight traffic onward so it bridges nodes
@@ -1413,7 +1434,7 @@ class P2PNode:
     # ------------------------------------------------------------------
     def set_start_learning(self, rounds: int, epochs: int = 1) -> None:
         """Initiator entry point (node.py:224)."""
-        asyncio.create_task(self._kickoff(rounds, epochs))
+        self._track_task(self._kickoff(rounds, epochs), "kickoff")
 
     async def _kickoff(self, rounds: int, epochs: int) -> None:
         await self.broadcast(
@@ -1435,10 +1456,11 @@ class P2PNode:
         if leader is not None:
             self.leader = leader
             self.leader_history.append(leader)
-        asyncio.create_task(
+        self._track_task(
             self.broadcast(
                 Message(MsgType.ROLE, self.idx, {"role": self.role})
-            )
+            ),
+            "role_announce",
         )  # heartbeater.py:74 SEND_ROLE analog — peers learn who aggregates
         self._learn_task = asyncio.create_task(self._learning_loop())
 

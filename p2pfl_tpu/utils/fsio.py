@@ -1,0 +1,29 @@
+"""Atomic file publication helpers.
+
+Any artifact another process tails live (status JSON, dashboards,
+topology maps, health probes) must never be observable empty or
+half-written. The contract — shared with
+``federation.checkpoint._atomic_write_bytes`` — is: write a ``tmp``
+sibling in the same directory, fsync it, then ``os.replace`` onto the
+published name, which POSIX guarantees is atomic within a filesystem.
+"""
+
+from __future__ import annotations
+
+import os
+import pathlib
+
+
+def atomic_write_bytes(path: pathlib.Path, data: bytes) -> None:
+    path = pathlib.Path(path)
+    tmp = path.with_name(path.name + ".tmp")
+    with open(tmp, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def atomic_write_text(path: pathlib.Path, text: str,
+                      encoding: str = "utf-8") -> None:
+    atomic_write_bytes(path, text.encode(encoding))

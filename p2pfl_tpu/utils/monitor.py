@@ -19,7 +19,6 @@ from __future__ import annotations
 import dataclasses
 import html
 import json
-import os
 import pathlib
 import sys
 import threading
@@ -27,6 +26,7 @@ import time
 from typing import Any
 
 from p2pfl_tpu.obs.records import make_record
+from p2pfl_tpu.utils.fsio import atomic_write_text
 
 DEFAULT_LIVENESS_S = 20.0  # webserver/app.py:307-311 cutoff
 
@@ -54,9 +54,7 @@ def publish_status(directory: str | pathlib.Path, node: int,
     rec = make_record(int(node), **record)
     rec.setdefault("seq", _next_seq(directory, node))
     path = directory / f"node_{node}.status.json"
-    tmp = path.with_suffix(".json.tmp")
-    tmp.write_text(json.dumps(rec))
-    os.replace(tmp, path)
+    atomic_write_text(path, json.dumps(rec))
     return path
 
 
@@ -247,11 +245,11 @@ def watch(directory: str | pathlib.Path, interval_s: float = 1.0,
                              alerts=alerts)
         pane = render_alerts(alerts)
         if html_out:
-            out = pathlib.Path(html_out)
-            tmp = out.with_suffix(out.suffix + ".tmp")
-            tmp.write_text(render_html(statuses, liveness_s=liveness_s,
-                                       alerts=alerts))
-            os.replace(tmp, out)
+            atomic_write_text(
+                pathlib.Path(html_out),
+                render_html(statuses, liveness_s=liveness_s,
+                            alerts=alerts),
+            )
         if once:
             print(table + "\n" + pane)
             return

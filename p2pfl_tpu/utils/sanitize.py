@@ -1,0 +1,65 @@
+"""``P2PFL_SANITIZE=1`` — opt-in runtime sanitizer.
+
+One environment variable turns on every cheap bug-surfacing mode at
+once, for local debugging and the tier-1 sanitized smoke test:
+
+- ``jax_debug_nans``: a NaN produced inside a jitted computation
+  raises at the op that made it instead of poisoning the aggregate
+  rounds later;
+- asyncio debug mode (pass ``sanitize.asyncio_debug()`` to
+  ``asyncio.run``): slow-callback warnings (the round-11 event-loop
+  blocking class) and never-retrieved task exceptions get tracebacks;
+- ``ResourceWarning`` and "coroutine ... was never awaited"
+  ``RuntimeWarning`` become errors, so leaked transports/files and
+  dropped coroutines fail the run instead of scrolling past.
+
+Usage::
+
+    P2PFL_SANITIZE=1 python -m p2pfl_tpu.p2p.launch config.yaml
+
+    with sanitize.scope():          # no-op unless enabled
+        run_simulation(cfg)
+
+The ``scope`` context manager saves and restores both the jax config
+flag and the warnings filters, so tests can nest it without leaking
+state into the rest of the suite.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import warnings
+
+ENV_VAR = "P2PFL_SANITIZE"
+
+
+def enabled() -> bool:
+    return os.environ.get(ENV_VAR, "").strip() not in ("", "0", "false")
+
+
+def asyncio_debug() -> bool | None:
+    """Value for ``asyncio.run(..., debug=...)``: ``True`` under the
+    sanitizer, ``None`` (leave the interpreter default) otherwise."""
+    return True if enabled() else None
+
+
+@contextlib.contextmanager
+def scope():
+    """Activate the sanitizer for a block (no-op when disabled)."""
+    if not enabled():
+        yield
+        return
+    import jax
+
+    prev_nans = jax.config.jax_debug_nans
+    jax.config.update("jax_debug_nans", True)
+    try:
+        with warnings.catch_warnings():
+            warnings.simplefilter("error", ResourceWarning)
+            warnings.filterwarnings(
+                "error", message=r"coroutine .* was never awaited",
+                category=RuntimeWarning)
+            yield
+    finally:
+        jax.config.update("jax_debug_nans", prev_nans)

@@ -1,143 +1,22 @@
 #!/usr/bin/env python
-"""Bench-key / documentation sync check (round 9).
-
-``bench.BENCH_KEYS`` is the authoritative registry of every top-level
-key the bench can emit, and docs/perf.md §10 is its human-facing
-reference. This script fails (exit 1) when either side drifts:
-
-1. a registered key is not mentioned anywhere in docs/perf.md
-   (substring check — the §10 tables name each key in backticks);
-2. bench.py emits a literal key that is not registered — best-effort
-   AST scan of the emission sites: dict literals handed to
-   ``_part(...)``, dicts assigned/updated/returned through the
-   accumulator names (``out``/``part``/``part_w``/``state``/``mp``)
-   inside emitting functions, and constant-key subscript stores to
-   those names. Dynamic keys (f-strings, loop variables) are out of
-   scope by design — they must still be registered by hand, which
-   direction 1 then keeps documented.
-3. the regression gate's HEADLINE keys
-   (scripts/check_bench_regress.py) are not all registered in
-   ``BENCH_KEYS`` — the gate must never anchor on a key the bench
-   cannot emit (round 12).
-
-Run directly (``python scripts/check_bench_keys.py``) or via the
-tier-1 suite (tests/test_bench_orchestration.py).
+"""Thin shim — the bench-key three-way sync check lives in
+:mod:`p2pfl_tpu.analysis.benchkeys` (round 15; single static-analysis
+entry point is ``python -m p2pfl_tpu.analysis``). This wrapper keeps
+the historical invocation (``python scripts/check_bench_keys.py``, and
+the tier-1 subprocess test) working with an identical stdout/exit-code
+contract: "ok: ..." on success, one line per drift and exit 1
+otherwise.
 """
 
 from __future__ import annotations
 
-import ast
 import pathlib
 import sys
 
 REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
 
-# names bench.py's emitting functions accumulate result dicts into
-_EMIT_NAMES = {"out", "part", "part_w", "state", "mp"}
-# emitters not discoverable from ``_part(<fn>())`` call shapes: main()
-# owns the envelope dict; _vit32_inprocess streams through a subprocess
-_EXTRA_EMITTERS = {"main", "_vit32_inprocess"}
-
-
-def _dict_keys(d: ast.Dict) -> set[str]:
-    return {k.value for k in d.keys
-            if isinstance(k, ast.Constant) and isinstance(k.value, str)}
-
-
-def _emitting_functions(tree: ast.Module) -> set[str]:
-    """``_phase_*`` children plus any function whose return value is
-    passed straight to ``_part``."""
-    names = set(_EXTRA_EMITTERS)
-    for node in ast.walk(tree):
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            if node.name.startswith("_phase_"):
-                names.add(node.name)
-        if (isinstance(node, ast.Call) and isinstance(node.func, ast.Name)
-                and node.func.id == "_part"):
-            for arg in node.args:
-                if (isinstance(arg, ast.Call)
-                        and isinstance(arg.func, ast.Name)):
-                    names.add(arg.func.id)
-    return names
-
-
-def emitted_literal_keys(tree: ast.Module) -> set[str]:
-    emitters = _emitting_functions(tree)
-    keys: set[str] = set()
-    for fn in ast.walk(tree):
-        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            continue
-        if fn.name not in emitters:
-            continue
-        for node in ast.walk(fn):
-            if isinstance(node, ast.Assign):
-                for tgt in node.targets:
-                    if (isinstance(tgt, ast.Subscript)
-                            and isinstance(tgt.value, ast.Name)
-                            and tgt.value.id in _EMIT_NAMES
-                            and isinstance(tgt.slice, ast.Constant)
-                            and isinstance(tgt.slice.value, str)):
-                        keys.add(tgt.slice.value)
-                    elif (isinstance(tgt, ast.Name)
-                            and tgt.id in _EMIT_NAMES
-                            and isinstance(node.value, ast.Dict)):
-                        keys |= _dict_keys(node.value)
-            elif isinstance(node, ast.AnnAssign):
-                if (isinstance(node.target, ast.Name)
-                        and node.target.id in _EMIT_NAMES
-                        and isinstance(node.value, ast.Dict)):
-                    keys |= _dict_keys(node.value)
-            elif isinstance(node, ast.Return):
-                vals = ([node.value] if isinstance(node.value, ast.Dict)
-                        else node.value.values
-                        if isinstance(node.value, ast.BoolOp) else [])
-                for v in vals:
-                    if isinstance(v, ast.Dict):
-                        keys |= _dict_keys(v)
-            elif isinstance(node, ast.Call):
-                f = node.func
-                args = [a for a in node.args if isinstance(a, ast.Dict)]
-                if isinstance(f, ast.Name) and f.id == "_part":
-                    for a in args:
-                        keys |= _dict_keys(a)
-                elif (isinstance(f, ast.Attribute) and f.attr == "update"
-                        and isinstance(f.value, ast.Name)
-                        and f.value.id in _EMIT_NAMES):
-                    for a in args:
-                        keys |= _dict_keys(a)
-    return keys
-
-
-def main() -> int:
-    sys.path.insert(0, str(REPO))
-    sys.path.insert(0, str(REPO / "scripts"))
-    import bench
-
-    registered = set(bench.BENCH_KEYS)
-    doc = (REPO / "docs" / "perf.md").read_text()
-    tree = ast.parse((REPO / "bench.py").read_text())
-    emitted = emitted_literal_keys(tree)
-
-    import check_bench_regress
-
-    undocumented = sorted(k for k in registered if k not in doc)
-    unregistered = sorted(emitted - registered)
-    ungated = sorted(set(check_bench_regress.HEADLINE) - registered)
-    for k in undocumented:
-        print(f"BENCH_KEYS entry not documented in docs/perf.md: {k!r}")
-    for k in unregistered:
-        print(f"bench.py emits a key missing from BENCH_KEYS: {k!r}")
-    for k in ungated:
-        print("check_bench_regress.HEADLINE key missing from "
-              f"BENCH_KEYS: {k!r}")
-    if undocumented or unregistered or ungated:
-        return 1
-    print(f"ok: {len(registered)} registered keys documented, "
-          f"{len(emitted)} literal emission keys all registered, "
-          f"{len(check_bench_regress.HEADLINE)} regression-gate keys "
-          "registered")
-    return 0
-
+from p2pfl_tpu.analysis.benchkeys import emitted_literal_keys, main  # noqa: E402,F401
 
 if __name__ == "__main__":
     sys.exit(main())

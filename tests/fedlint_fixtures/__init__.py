@@ -1,0 +1,7 @@
+"""fedlint fixture corpus — parse-only inputs for tests/test_fedlint.py.
+
+Each rule has one positive (``*_pos.py``, must be flagged) and one
+negative (``*_neg.py``, must be clean) case. These files are never
+imported or executed — only handed to ``ast.parse`` by the lint — so
+undefined names are fine.
+"""

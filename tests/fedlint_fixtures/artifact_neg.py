@@ -1,0 +1,21 @@
+"""Negative: the atomic-publication contract — tmp+fsync+os.replace,
+append-mode records, plain reads."""
+import json
+import os
+
+
+def publish(directory, record):
+    path = directory / "node_0.status.json"
+    tmp = path.with_suffix(".json.tmp")
+    tmp.write_text(json.dumps(record))
+    os.replace(tmp, path)
+
+
+def append(directory, record):
+    with open(directory / "metrics.jsonl", "a") as f:
+        f.write(json.dumps(record) + "\n")
+
+
+def read(directory):
+    with open(directory / "metrics.jsonl") as f:
+        return f.read()

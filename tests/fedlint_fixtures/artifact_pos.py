@@ -1,0 +1,9 @@
+"""Positive: the round-12/14 torn-read class — in-place publication
+of tailed artifacts."""
+import json
+
+
+def publish(directory, record):
+    (directory / "node_0.status.json").write_text(json.dumps(record))
+    with open(directory / "metrics.json", "w") as f:  # truncates in place
+        f.write(json.dumps(record))

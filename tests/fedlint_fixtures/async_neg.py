@@ -1,0 +1,14 @@
+"""Negative: awaited sleep, tracked task, blocking work in sync code."""
+import asyncio
+import time
+
+
+async def prober(node, tasks):
+    await asyncio.sleep(0.5)
+    task = asyncio.create_task(node.probe())  # reference kept
+    tasks.append(task)
+    await task
+
+
+def sync_helper():
+    time.sleep(0.1)  # off-loop: blocking is fine here

@@ -1,0 +1,11 @@
+"""Positive: the round-11 prober class — event-loop blocking plus a
+fire-and-forget task."""
+import asyncio
+import time
+
+
+async def prober(node):
+    time.sleep(0.5)                    # blocks every coroutine
+    data = open("state.bin").read()    # sync IO on the loop
+    asyncio.create_task(node.probe())  # no reference, no exception sink
+    return data

@@ -1,0 +1,19 @@
+"""Negative: the correct round-9 fix shapes — owning rebinds, zip
+positional alignment, rebind-on-the-call-line."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import serialization as flax_ser
+
+step = jax.jit(train_step, donate_argnums=(0,))  # noqa: F821
+
+
+def resume(blob, template, state):
+    restored = flax_ser.msgpack_restore(blob)
+    flat = jax.tree.leaves(restored)
+    owned = [jnp.array(leaf, copy=True) for leaf in flat]
+    for t, r in zip(jax.tree.leaves(template), flat):
+        dev = jnp.asarray(t)            # t aligned with the owning side
+        own = np.array(r, copy=True)    # owning rebind of the view
+    state = step(state)                 # rebound on the call line
+    return state.loss, owned, dev, own

@@ -1,0 +1,16 @@
+"""Positive: the round-9 bug class, both sub-checks."""
+import jax
+import jax.numpy as jnp
+from flax import serialization as flax_ser
+
+step = jax.jit(train_step, donate_argnums=(0,))  # noqa: F821
+
+
+def resume(blob, state):
+    restored = flax_ser.msgpack_restore(blob)
+    leaves = jax.tree.leaves(restored)
+    arrs = [jnp.asarray(leaf) for leaf in leaves]  # non-owning sink
+    donated = step(restored)                       # donated tainted buffer
+    out = step(state)
+    loss = state.loss                              # read after donate
+    return arrs, donated, out, loss

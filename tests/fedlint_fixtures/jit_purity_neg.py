@@ -1,0 +1,20 @@
+"""Negative: pure traced functions; host work stays outside; a
+Pallas-style ref store through a parameter is fine."""
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def body(carry, x):
+    return carry + jnp.sum(x), x
+
+
+def kernel(o_ref, x):
+    o_ref[...] = x * 2.0  # o_ref is a parameter — local store
+
+
+def run(xs):
+    out, ys = lax.scan(body, 0.0, xs)
+    jitted = jax.jit(kernel)
+    print("scan done", out)  # host code outside the traced fns
+    return jitted, ys

@@ -1,0 +1,14 @@
+"""Positive: host side effects inside a jitted function."""
+import jax
+import numpy as np
+
+METRICS = {}
+
+
+@jax.jit
+def train_step(state, batch, tracer):
+    print("step")              # runs once, at trace time
+    host = np.asarray(batch)   # host transfer / tracer error
+    tracer.count("steps")      # counter frozen after trace
+    METRICS["loss"] = 0.0      # non-local mutation
+    return state, host

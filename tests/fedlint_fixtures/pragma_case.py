@@ -1,0 +1,8 @@
+"""Pragma suppression: the finding exists but is disabled in place."""
+import asyncio
+
+
+def kick(node):
+    # intentionally unreferenced: probe is best-effort, failure is
+    # expected and logged by the probe itself
+    asyncio.create_task(node.probe())  # fedlint: disable=async-hygiene

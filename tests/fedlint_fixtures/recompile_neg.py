@@ -1,0 +1,14 @@
+"""Negative: the fixed shapes — hoisted stack/jit, gated or static
+counter keys."""
+import jax
+import jax.numpy as jnp
+
+step = jax.jit(lambda x: x + 1)
+
+
+def aggregate(parts, tracer):
+    stacked = jnp.stack(parts)               # once per aggregation
+    if tracer.enabled:
+        tracer.count(f"agg_{len(parts)}")    # gated: free when off
+    tracer.count("agg_total")                # static key
+    return step(stacked)

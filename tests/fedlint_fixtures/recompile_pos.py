@@ -1,0 +1,13 @@
+"""Positive: the §7b storm class — stack in a loop, jit in a loop,
+ungated f-string counter key."""
+import jax
+import jax.numpy as jnp
+
+
+def aggregate(parts, tracer):
+    outs = []
+    for part in parts:
+        outs.append(jnp.stack(part))     # retraces per list length
+        fn = jax.jit(lambda x: x + 1)    # fresh callable per iteration
+    tracer.count(f"agg_{len(parts)}")    # allocates with tracing off
+    return outs, fn

@@ -1,0 +1,178 @@
+"""P2PFL_SANITIZE runtime sanitizer (round 15) + the tracked-task
+regression tests for this round's async-hygiene fixes."""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import warnings
+
+import pytest
+
+from p2pfl_tpu.utils import sanitize
+
+
+# ---------------------------------------------------------------------
+# sanitize switch mechanics
+# ---------------------------------------------------------------------
+
+def test_enabled_parsing(monkeypatch):
+    for off in ("", "0", "false"):
+        monkeypatch.setenv(sanitize.ENV_VAR, off)
+        assert not sanitize.enabled()
+        assert sanitize.asyncio_debug() is None
+    monkeypatch.delenv(sanitize.ENV_VAR, raising=False)
+    assert not sanitize.enabled()
+    monkeypatch.setenv(sanitize.ENV_VAR, "1")
+    assert sanitize.enabled()
+    assert sanitize.asyncio_debug() is True
+
+
+def test_scope_is_noop_when_disabled(monkeypatch):
+    monkeypatch.delenv(sanitize.ENV_VAR, raising=False)
+    with sanitize.scope():
+        warnings.warn("leak", ResourceWarning)  # must not raise
+
+
+def test_scope_toggles_and_restores_debug_nans(monkeypatch):
+    import jax
+
+    monkeypatch.setenv(sanitize.ENV_VAR, "1")
+    before = jax.config.jax_debug_nans
+    assert before is False  # the suite never runs with it on
+    with sanitize.scope():
+        assert jax.config.jax_debug_nans is True
+    assert jax.config.jax_debug_nans is False
+
+
+def test_scope_promotes_warnings_to_errors(monkeypatch):
+    monkeypatch.setenv(sanitize.ENV_VAR, "1")
+    with sanitize.scope():
+        with pytest.raises(ResourceWarning):
+            warnings.warn("unclosed transport", ResourceWarning)
+        with pytest.raises(RuntimeWarning):
+            warnings.warn("coroutine 'f' was never awaited",
+                          RuntimeWarning)
+    # filters restored: the same warning is non-fatal outside
+    warnings.warn("unclosed transport", ResourceWarning)
+
+
+def test_sanitize_catches_nan_in_jit(monkeypatch):
+    import jax
+    import jax.numpy as jnp
+
+    monkeypatch.setenv(sanitize.ENV_VAR, "1")
+
+    @jax.jit
+    def bad(x):
+        return jnp.log(x - 1.0)  # log(0) at x=1 -> -inf, 0/0 -> nan
+
+    with sanitize.scope():
+        with pytest.raises(FloatingPointError):
+            jax.block_until_ready(bad(jnp.float32(1.0)) * 0.0)
+
+
+# ---------------------------------------------------------------------
+# Node._track_task — regression for the fire-and-forget fixes
+# ---------------------------------------------------------------------
+
+def _bare_node():
+    from p2pfl_tpu.p2p.node import P2PNode
+
+    node = P2PNode.__new__(P2PNode)  # the helper only touches _tasks/idx
+    node._tasks = []
+    node.idx = 7
+    return node
+
+
+def test_track_task_consumes_and_logs_exception(caplog):
+    """A failing background task must be pruned AND have its exception
+    retrieved + logged — a bare create_task reported it only at
+    interpreter exit (the round-11 prober class)."""
+
+    async def boom():
+        raise RuntimeError("kaput")
+
+    async def main():
+        node = _bare_node()
+        task = node._track_task(boom(), "boom")
+        assert task in node._tasks  # pinned against GC
+        for _ in range(3):
+            await asyncio.sleep(0)
+        assert task.done()
+        assert node._tasks == []  # pruned on completion
+        return task
+
+    with caplog.at_level(logging.ERROR, logger="p2pfl_tpu.p2p"):
+        task = asyncio.run(main())
+    assert "kaput" in caplog.text and "boom" in caplog.text
+    # the callback retrieved the exception; this must not warn/raise
+    assert isinstance(task.exception(), RuntimeError)
+
+
+def test_track_task_success_is_silent(caplog):
+    async def ok():
+        return 42
+
+    async def main():
+        node = _bare_node()
+        node._track_task(ok(), "ok")
+        for _ in range(3):
+            await asyncio.sleep(0)
+        assert node._tasks == []
+
+    with caplog.at_level(logging.ERROR, logger="p2pfl_tpu.p2p"):
+        asyncio.run(main())
+    assert "failed" not in caplog.text
+
+
+# ---------------------------------------------------------------------
+# atomic publication — regression for the topology_3d.json fix
+# ---------------------------------------------------------------------
+
+def test_atomic_write_text_leaves_no_tmp(tmp_path):
+    from p2pfl_tpu.utils.fsio import atomic_write_text
+
+    out = tmp_path / "topology_3d.json"
+    atomic_write_text(out, '{"nodes": []}')
+    assert out.read_text() == '{"nodes": []}'
+    atomic_write_text(out, '{"nodes": [1]}')  # atomic overwrite
+    assert out.read_text() == '{"nodes": [1]}'
+    assert list(tmp_path.iterdir()) == [out]  # no .tmp left behind
+
+
+# ---------------------------------------------------------------------
+# the satellite smoke test: 4 nodes, sanitized round
+# ---------------------------------------------------------------------
+
+def test_sanitized_simulated_round(monkeypatch):
+    """run_simulation under P2PFL_SANITIZE=1: a full 4-node ring round
+    with jax_debug_nans, asyncio debug mode, and warnings-as-errors —
+    a leaked transport, dropped coroutine, or NaN anywhere in the
+    round path fails this test."""
+    import jax
+
+    from p2pfl_tpu.config.schema import (
+        DataConfig,
+        NetworkConfig,
+        ProtocolConfig,
+        ScenarioConfig,
+        TrainingConfig,
+    )
+    from p2pfl_tpu.p2p.launch import run_simulation
+
+    monkeypatch.setenv(sanitize.ENV_VAR, "1")
+    cfg = ScenarioConfig(
+        name="sanitize4", n_nodes=4, topology="ring",
+        data=DataConfig(dataset="mnist", samples_per_node=100),
+        training=TrainingConfig(rounds=1, epochs_per_round=1,
+                                learning_rate=0.05),
+        protocol=ProtocolConfig(heartbeat_period_s=0.3,
+                                aggregation_timeout_s=30.0,
+                                vote_timeout_s=5.0),
+        network=NetworkConfig(delay_ms=5, seed=2),
+    )
+    out = run_simulation(cfg, timeout=240)
+    assert out["n_nodes"] == 4 and out["rounds"] == 1
+    # the sanitizer restored global state for the rest of the suite
+    assert jax.config.jax_debug_nans is False

@@ -1027,6 +1027,19 @@ _CHAOS_KEYS = (
     "chaos_partitions", "chaos_restarted",
 )
 
+# keys the aggd phase (round 15: shared-memory aggregation sidecar
+# A/B) emits; static so BENCH_KEYS and the P2PFL_AGGD_DRY plan stay
+# authoritative
+_AGGD_KEYS = (
+    "aggd_round_s_24node_uncapped",
+    "aggd_inline_round_s_24node_uncapped",
+    "aggd_speedup",
+    "aggd_bytes_ingested", "aggd_fallbacks",
+    "aggd_loop_payload_touch_bytes",
+    "aggd_inline_loop_payload_touch_bytes",
+    "aggd_accuracy_sidecar", "aggd_accuracy_inline",
+)
+
 # Authoritative registry of every top-level key bench can emit.
 # scripts/check_bench_keys.py asserts each one is documented in
 # docs/perf.md (§10 key reference) and that no emission site uses a
@@ -1076,6 +1089,8 @@ BENCH_KEYS = (
     "crossdev_dry", "crossdev_keys", *_CROSSDEV_KEYS,
     # chaos (round 14: partition-tolerance + crash-consistent restart)
     "chaos_dry", "chaos_keys", *_CHAOS_KEYS,
+    # aggd (round 15: shared-memory aggregation sidecar A/B)
+    "aggd_dry", "aggd_keys", *_AGGD_KEYS,
     # run-metadata stamp (round 12 regression gate provenance)
     "meta",
     # orchestration-test hook
@@ -2147,6 +2162,92 @@ print("BENCH_CHAOS " + json.dumps({"clean": clean, "chaos": chaos}),
               flush=True)
 
 
+def _phase_aggd() -> None:
+    """Aggregation-plane A/B (round 15: shared-memory sidecar): the
+    24-node UNCAPPED simulation scenario — the same payload-bound
+    config the comm phase times — with ``aggregation_plane`` inline vs
+    sidecar, interleaved min-of-2 via ``_ab_interleaved``. Gates:
+    sidecar round time <= inline, same-seed accuracy identical, the
+    event loop's payload-touch byte counter 0 on the sidecar arm (the
+    zero-copy ingest claim, also pinned by tests/test_aggd.py), zero
+    fuse fallbacks. Runs in a CPU subprocess like _phase_comm part (a)
+    — asyncio nodes cannot share the bench chip.
+
+    ``P2PFL_AGGD_DRY=1`` emits the key plan without touching the
+    accelerator — the orchestration test's smoke hook."""
+    if os.environ.get("P2PFL_AGGD_DRY") == "1":
+        _part({"aggd_dry": True, "aggd_keys": list(_AGGD_KEYS)})
+        return
+
+    import json as _json
+    import subprocess
+
+    code = r"""
+import os, re, json
+flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+               os.environ.get("XLA_FLAGS", "")).strip()
+os.environ["XLA_FLAGS"] = flags
+import jax
+jax.config.update("jax_platforms", "cpu")
+import sys; sys.path.insert(0, %r)
+import bench
+from p2pfl_tpu.config.schema import (ScenarioConfig, TrainingConfig,
+    ProtocolConfig, DataConfig)
+from p2pfl_tpu.p2p.launch import run_simulation
+
+def cfg(plane):
+    return ScenarioConfig(
+        name="aggd24u", n_nodes=24, topology="fully",
+        data=DataConfig(dataset="mnist", samples_per_node=60),
+        training=TrainingConfig(rounds=3, epochs_per_round=1,
+                                learning_rate=0.05),
+        protocol=ProtocolConfig(heartbeat_period_s=0.5,
+                                aggregation_timeout_s=60.0,
+                                vote_timeout_s=10.0, train_set_size=24,
+                                gossip_fanout=12),
+        aggregation_plane=plane,
+    )
+
+def arm(plane):
+    return lambda: run_simulation(cfg(plane), timeout=280)
+
+inline, sidecar = bench._ab_interleaved(arm("inline"), arm("sidecar"))
+print("BENCH_AGGD " + json.dumps({"inline": inline, "sidecar": sidecar}),
+      flush=True)
+""" % (_REPO,)
+    try:
+        res = subprocess.run([sys.executable, "-c", code],
+                             capture_output=True, text=True, timeout=420)
+        got = None
+        for line in res.stdout.splitlines():
+            if line.startswith("BENCH_AGGD "):
+                got = _json.loads(line[len("BENCH_AGGD "):])
+        if not got:
+            print(f"aggd child rc={res.returncode}: "
+                  f"{res.stderr[-400:]}", file=sys.stderr, flush=True)
+            return
+        inline, sidecar = got.get("inline") or {}, got.get("sidecar") or {}
+        part = {
+            "aggd_round_s_24node_uncapped": sidecar.get("round_s"),
+            "aggd_inline_round_s_24node_uncapped": inline.get("round_s"),
+            "aggd_bytes_ingested": sidecar.get("aggd_bytes_ingested"),
+            "aggd_fallbacks": sidecar.get("aggd_fallbacks"),
+            "aggd_loop_payload_touch_bytes":
+                sidecar.get("loop_payload_touch_bytes"),
+            "aggd_inline_loop_payload_touch_bytes":
+                inline.get("loop_payload_touch_bytes"),
+            "aggd_accuracy_sidecar": sidecar.get("mean_accuracy"),
+            "aggd_accuracy_inline": inline.get("mean_accuracy"),
+        }
+        if inline.get("round_s") and sidecar.get("round_s"):
+            part["aggd_speedup"] = round(
+                inline["round_s"] / sidecar["round_s"], 2)
+        _part(part)
+    except Exception as e:
+        print(f"aggd phase failed: {e!r}"[:300], file=sys.stderr,
+              flush=True)
+
+
 def _run_meta() -> dict:
     """Provenance stamp for every BENCH json — what
     scripts/check_bench_regress.py prints next to its verdict, so a
@@ -2319,6 +2420,7 @@ def main() -> None:
         ("elastic", "_phase_elastic", 150),
         ("cross_device", "_phase_cross_device", 120),
         ("chaos", "_phase_chaos", 120),
+        ("aggd", "_phase_aggd", 120),
         ("vit32", "_phase_vit32", 120),
     ]
     for name, fn, min_s in phases:

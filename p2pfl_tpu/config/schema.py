@@ -469,6 +469,12 @@ class ScenarioConfig:
     # "off" — convergence must be pinned by the bench A/B before a
     # scenario opts in (docs/perf.md §11).
     exchange_overlap: str = "off"
+    # where weighted-FedAvg accumulation runs on the socket plane:
+    # "inline" fuses in the node's own process (executor thread);
+    # "sidecar" spawns one aggd process per host owning a shared-memory
+    # slot arena — payload bytes land in slots straight off the socket
+    # and the event loop never touches them (docs/perf.md §16)
+    aggregation_plane: str = "inline"
     # mutual TLS on the socket path (the reference's encrypter knob,
     # base_node.py:62; scenario certs minted at launch)
     encrypt: bool = False
@@ -529,6 +535,50 @@ class ScenarioConfig:
                 raise ValueError(
                     "cross_device uses the cohort-scan round, not the "
                     "ppermute transport; leave transport 'auto'/'dense'"
+                )
+        if self.aggregation_plane not in ("inline", "sidecar"):
+            raise ValueError(
+                f"unknown aggregation_plane {self.aggregation_plane!r}; "
+                "have ('inline', 'sidecar')"
+            )
+        if self.aggregation_plane == "sidecar":
+            # the sidecar fuses from raw header metadata + slot bytes —
+            # refuse every combination that needs payloads DECODED on
+            # the node (the sparse-transport refusal idiom: fail loud
+            # instead of silently aggregating something else)
+            if self.aggregator != "fedavg":
+                raise ValueError(
+                    "aggregation_plane='sidecar' implements weighted "
+                    "FedAvg only; use aggregator='fedavg'"
+                )
+            if self.federation != "DFL":
+                raise ValueError(
+                    "aggregation_plane='sidecar' supports DFL only: "
+                    "CFL/SDFL leader hand-off re-enters partials the "
+                    "slot plane has no bookkeeping for"
+                )
+            if self.topology != "fully":
+                raise ValueError(
+                    "aggregation_plane='sidecar' requires "
+                    "topology='fully': partial-aggregation gossip on "
+                    "sparse meshes needs decoded trees on the node"
+                )
+            if self.encrypt:
+                raise ValueError(
+                    "aggregation_plane='sidecar' composes with "
+                    "encrypt=False only: TLS frames are decrypted in "
+                    "the event loop, defeating the zero-touch ingest"
+                )
+            if self.adversary.active or self.adversary.reputation:
+                raise ValueError(
+                    "aggregation_plane='sidecar' has no adversary/"
+                    "reputation hooks: observe_entries needs decoded "
+                    "trees on the node"
+                )
+            if self.cross_device.active:
+                raise ValueError(
+                    "aggregation_plane='sidecar' is a socket-plane "
+                    "feature; cross_device runs the cohort-scan round"
                 )
         if not self.nodes:
             self.nodes = self._default_nodes()

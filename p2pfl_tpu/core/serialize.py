@@ -99,6 +99,36 @@ class ParamsPayload:
     params: Any
     contributors: tuple[int, ...] = ()
     weight: int = 1
+    #: the wire blob the leaves view into — ``decode_parameters`` never
+    #: copies, so the whole received buffer (or shared-memory slot)
+    #: stays alive for as long as ``params`` does. ``release()`` severs
+    #: it once the payload's useful life ends.
+    _source: Any = dataclasses.field(default=None, repr=False, compare=False)
+
+    def release(self) -> "ParamsPayload":
+        """Owning-copy boundary: replace every leaf that still views
+        the wire blob with an owning copy and drop the blob reference,
+        making the blob (or the shm slot backing it) collectable /
+        reusable. Idempotent; returns self for chaining."""
+        self.params = own_params(self.params)
+        self._source = None
+        return self
+
+
+def own_params(params: Any) -> Any:
+    """Return ``params`` with every non-owning leaf (msgpack_restore
+    views into a wire blob, shared-memory slot views) replaced by an
+    owning ``np.array`` copy. Leaves that already own their buffer pass
+    through untouched, so calling this on an aggregation result (fresh
+    accumulator arrays) costs only the flag checks."""
+
+    def leaf(a):
+        arr = np.asarray(a)
+        if arr.flags.owndata and arr.base is None:
+            return a
+        return np.array(arr)
+
+    return jax.tree.map(leaf, params)
 
 
 def encode_parameters(params: Any, contributors: tuple[int, ...] = (),
@@ -183,7 +213,8 @@ def decode_parameters(blob: bytes) -> ParamsPayload:
                 [np.asarray(leaf).astype(np.dtype(dt))
                  for leaf, dt in zip(leaves, dts)])
         return ParamsPayload(
-            params=p, contributors=tuple(contributors), weight=int(obj["w"])
+            params=p, contributors=tuple(contributors),
+            weight=int(obj["w"]), _source=blob,
         )
     except DecodingParamsError:
         raise

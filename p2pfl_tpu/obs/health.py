@@ -93,6 +93,9 @@ class HealthConfig:
     recompile_storm: int = 32
     divergence: float = 0.15
     min_cohort: int = 3  # cohort-relative rules need a real median
+    # sidecar-stalled: descriptor-queue depth at/above this while slot
+    # releases sit flat across two evaluations reads as a wedged aggd
+    sidecar_backlog: int = 4
 
 
 @dataclasses.dataclass
@@ -300,6 +303,34 @@ def rule_partition_suspected(snap: Snapshot,
                         f"cut: {desc}"}]
 
 
+def rule_sidecar_stalled(snap: Snapshot, eng: "HealthEngine") -> list[dict]:
+    """A healthy aggd drains its descriptor queue and releases payload
+    slots every round; a wedged one (worker stuck in a decode, arena
+    exhausted by leaked slots) shows the queue DEEPENING while the
+    release counter sits flat. Delta-state rule like
+    partition-suspected: judged against the previous evaluation's
+    (depth, releases) baseline, so a single busy snapshot can't fire."""
+    out = []
+    for rec in snap.alive():
+        depth, rel = rec.get("aggd_desc_q_depth"), rec.get("aggd_slot_releases")
+        if depth is None or rel is None:
+            continue
+        node = int(rec.get("node", -1))
+        prev = eng.aggd_state.get(node)
+        if prev is None:
+            continue  # first sighting — no delta to judge
+        depth, rel = int(depth), int(rel)
+        if (depth > prev[0] and depth >= snap.cfg.sidecar_backlog
+                and rel == prev[1]):
+            out.append({
+                "node": node,
+                "message": f"aggregation sidecar stalled: descriptor "
+                           f"queue {prev[0]}->{depth} deep with slot "
+                           f"releases flat at {rel}",
+            })
+    return out
+
+
 @dataclasses.dataclass(frozen=True)
 class Rule:
     name: str
@@ -316,6 +347,7 @@ def default_rules() -> list[Rule]:
         Rule("recompile-storm", "warn", rule_recompile_storm),
         Rule("accuracy-divergence", "warn", rule_accuracy_divergence),
         Rule("partition-suspected", "crit", rule_partition_suspected),
+        Rule("sidecar-stalled", "warn", rule_sidecar_stalled),
     ]
 
 
@@ -337,6 +369,9 @@ class HealthEngine:
         # node -> per-peer combined wire totals at the previous
         # evaluation (partition-suspected's delta baseline)
         self.peer_bytes: dict[int, dict[int, int]] = {}
+        # node -> (desc-queue depth, slot releases) at the previous
+        # evaluation (sidecar-stalled's delta baseline)
+        self.aggd_state: dict[int, tuple[int, int]] = {}
 
     # -- evaluation -----------------------------------------------------
     def _note_progress(self, snap: Snapshot) -> None:
@@ -351,6 +386,12 @@ class HealthEngine:
             tot = _peer_totals(rec)
             if tot is not None:
                 self.peer_bytes[int(rec.get("node", -1))] = tot
+        for rec in snap.statuses:
+            depth = rec.get("aggd_desc_q_depth")
+            rel = rec.get("aggd_slot_releases")
+            if depth is not None and rel is not None:
+                self.aggd_state[int(rec.get("node", -1))] = (
+                    int(depth), int(rel))
 
     def evaluate(self, statuses: list[dict[str, Any]],
                  metrics: list[dict[str, Any]] | None = None,

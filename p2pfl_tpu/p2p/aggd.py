@@ -1,0 +1,421 @@
+"""Aggregation sidecar: payload decode + fuse in a separate process.
+
+perf.md §7b/§7c left the uncapped 24-node socket federation floored by
+payload movement — every ~800 KB PARAMS blob was received, decoded and
+accumulated on the same asyncio loop that runs the node's control
+plane. This module is the smart-NIC FL-server analog (PAPERS.md): one
+``aggd`` process per host owns a ``multiprocessing.shared_memory``
+arena of payload slots; the protocol reader lands raw payload bytes
+straight into a leased slot (protocol.read_message's ``slot_sink``)
+and the event loop forwards only a small descriptor. Decode and the
+§7b numpy weighted-FedAvg accumulate happen in the sidecar; the fused
+result comes back through one shared result slot per session.
+
+Lifetime design (the part that makes /dev/shm leaks impossible):
+
+- the CLIENT creates the arena under a recognizable ``p2pfl_aggd_*``
+  name and the worker attaches by name;
+- the moment the worker confirms attachment, the client **unlinks the
+  name** while both sides keep their mappings. The kernel frees the
+  memory when the last mapping closes — even if every process involved
+  is SIGKILLed, nothing is left under /dev/shm;
+- both sides still unlink defensively at exit (suppressed
+  FileNotFoundError) for the window before the handshake lands.
+
+Slot accounting lives entirely in the client (single event loop +
+drain thread, one lock): the worker never allocates, it only reads the
+slots a fuse request names and writes the result slot the client
+leased for that request. A fuse whose reply never arrives (worker
+killed) falls back to in-process aggregation — loud flight event, no
+round lost.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import gc
+import itertools
+import multiprocessing
+import os
+import secrets
+import threading
+from contextlib import suppress
+from multiprocessing import shared_memory
+from typing import Any
+
+import jax
+import numpy as np
+
+from p2pfl_tpu.obs import flight
+from p2pfl_tpu.obs.trace import get_tracer
+
+#: arena names carry this prefix so tests (and operators) can audit
+#: /dev/shm for residue after crash/chaos runs
+SHM_PREFIX = "p2pfl_aggd_"
+#: slot-size floor — the arena is sized lazily from the first leased
+#: payload, and a tiny first frame must not wedge later full models
+_MIN_SLOT_BYTES = 1 << 16
+#: worker-side queue poll period; each timeout re-checks for orphaning
+_WORKER_POLL_S = 5.0
+
+
+def fuse_numpy(trees, weights) -> tuple[Any, float]:
+    """The §7b numpy weighted-FedAvg kernel, extracted from
+    ``AggregationSession._aggregate_numpy`` (round 7) so the inline
+    session and the sidecar worker share ONE implementation — the
+    tolerance-0 parity gate between the two planes is anchored on this
+    sharing, not on two copies staying in sync by discipline.
+
+    Returns ``(fused_tree, total_weight)``.
+    """
+    weights = np.asarray(weights, np.float32)
+    total = float(weights.sum())
+    if total > 0:
+        wn = weights / total
+    else:  # tree_weighted_mean degenerate-case parity
+        wn = np.full_like(weights, 1.0 / len(trees))
+        total = float(len(trees))
+    trees = [jax.tree.map(np.asarray, p) for p in trees]
+
+    def leaf(*xs):
+        acc = np.asarray(xs[0], np.float32) * wn[0]
+        for wi, x in zip(wn[1:], xs[1:]):
+            acc += np.asarray(x, np.float32) * wi
+        return acc.astype(np.asarray(xs[0]).dtype)
+
+    return jax.tree.map(leaf, *trees), total
+
+
+@dataclasses.dataclass(frozen=True)
+class SlotEntry:
+    """Marker a SidecarSession stores in place of a decoded tree: the
+    payload lives undecoded in the shared arena at ``slot``."""
+
+    slot: int
+    length: int
+
+
+def _sidecar_main(shm_name: str, n_slots: int, slot_bytes: int,
+                  desc_q, done_q) -> None:
+    """Worker entry (spawn context — never forks live asyncio/JAX
+    state). Attaches to the client's arena, confirms (which triggers
+    the client's early unlink), then serves fuse requests until a stop
+    sentinel, queue EOF, or orphaning (parent gone)."""
+    jax.config.update("jax_platforms", "cpu")
+    from p2pfl_tpu.core.serialize import decode_parameters, encode_parameters
+
+    parent = os.getppid()
+    try:
+        shm = shared_memory.SharedMemory(name=shm_name)
+    except FileNotFoundError:
+        return  # client died before we attached; nothing to serve
+    done_q.put(("attached",))
+
+    def view(slot: int, length: int) -> memoryview:
+        off = slot * slot_bytes
+        return shm.buf[off: off + length]
+
+    try:
+        while True:
+            try:
+                item = desc_q.get(timeout=_WORKER_POLL_S)
+            except Exception:  # Empty on timeout; EOF/OSError on close
+                if os.getppid() != parent:
+                    break  # orphaned: client is gone, exit
+                continue
+            if item is None or item[0] == "stop":
+                break
+            if item[0] != "fuse":
+                continue
+            _, req_id, entries, result_slot = item
+            try:
+                bytes_in = 0
+                if len(entries) == 1 and entries[0][0] == "s":
+                    # single-entry short-circuit mirrors _aggregate's
+                    # n==1 return-as-is: the envelope IS the result
+                    _, slot, length, _w = entries[0]
+                    view(result_slot, length)[:] = view(slot, length)
+                    done_q.put(("done", req_id, length,
+                                {"entries": 1, "bytes_in": length}))
+                    continue
+                trees, weights = [], []
+                for e in entries:
+                    if e[0] == "s":
+                        _, slot, length, w = e
+                        blob: Any = view(slot, length)
+                    else:
+                        _, blob, w = e
+                    bytes_in += len(blob)
+                    trees.append(decode_parameters(blob).params)
+                    weights.append(float(w))
+                fused, total = fuse_numpy(trees, weights)
+                out = encode_parameters(fused, (), max(1, int(total)))
+                if len(out) > slot_bytes:
+                    raise ValueError(
+                        f"fused blob {len(out)} B > slot {slot_bytes} B")
+                view(result_slot, len(out))[:] = out
+                done_q.put(("done", req_id, len(out),
+                            {"entries": len(entries), "bytes_in": bytes_in}))
+            except Exception as e:  # reply, never die — the client
+                # treats a missing reply as a crash and falls back
+                done_q.put(("err", req_id, f"{type(e).__name__}: {e}"[:300]))
+    finally:
+        with suppress(BufferError):
+            shm.close()
+        with suppress(FileNotFoundError):
+            shm.unlink()  # no-op normally: client unlinked on attach
+
+
+class SidecarClient:
+    """Per-host handle to one aggd worker + its shared-memory arena.
+
+    One client serves every node packed into the host process; slots
+    are leased/released on the event-loop thread and reclaimed from the
+    done-queue drain thread, so all free-list state sits behind one
+    lock. The arena is sized lazily from the first lease (2x the first
+    payload, floored) — callers must treat a ``None`` lease as "stay on
+    the inline path" (arena exhausted, payload oversized, or /dev/shm
+    unavailable), never as an error.
+    """
+
+    def __init__(self, n_slots: int = 16, lane: str | None = None):
+        self.n_slots = max(2, int(n_slots))
+        self.slot_bytes = 0
+        self._shm: shared_memory.SharedMemory | None = None
+        self._proc = None
+        self._desc_q = None
+        self._done_q = None
+        self._drain: threading.Thread | None = None
+        self._lock = threading.Lock()
+        self._free: list[int] = []
+        self._leased: set[int] = set()
+        # req_id -> (loop, event, reply box) for in-flight fuses, and
+        # req_id -> result slot so an abandoned (timed-out) request's
+        # slot is reclaimed only once the worker stops writing to it
+        self._waiters: dict[int, tuple] = {}
+        self._pending_result: dict[int, int] = {}
+        self._req_ids = itertools.count(1)
+        self._closed = False
+        self._unlinked = False
+        self._lane = lane
+        self._tracer = get_tracer()
+        #: payload bytes landed into leased slots (event-loop bypass)
+        self.bytes_ingested = 0
+        #: slots returned to the free list over the client's lifetime
+        self.slot_releases = 0
+        #: fuses answered by the worker / fallen back to in-process
+        self.fused_rounds = 0
+        self.fallbacks = 0
+
+    # -- lifecycle ------------------------------------------------------
+    def _ensure(self, nbytes: int) -> bool:
+        if self._closed:
+            return False
+        if self._shm is not None:
+            return True
+        self.slot_bytes = max(_MIN_SLOT_BYTES, 2 * int(nbytes))
+        name = SHM_PREFIX + secrets.token_hex(6)
+        try:
+            self._shm = shared_memory.SharedMemory(
+                name=name, create=True,
+                size=self.n_slots * self.slot_bytes)
+        except OSError:
+            self._closed = True  # no /dev/shm: permanent inline path
+            flight.record("aggd.error", lane=self._lane,
+                          error="shared memory unavailable")
+            return False
+        self._free = list(range(self.n_slots - 1, -1, -1))
+        ctx = multiprocessing.get_context("spawn")
+        self._desc_q = ctx.Queue()
+        self._done_q = ctx.Queue()
+        self._proc = ctx.Process(
+            target=_sidecar_main,
+            args=(name, self.n_slots, self.slot_bytes,
+                  self._desc_q, self._done_q),
+            daemon=True, name="p2pfl-aggd")
+        self._proc.start()
+        self._drain = threading.Thread(
+            target=self._drain_loop, daemon=True, name="aggd-drain")
+        self._drain.start()
+        flight.record("aggd.spawn", lane=self._lane, pid=self._proc.pid,
+                      n_slots=self.n_slots, slot_bytes=self.slot_bytes)
+        return True
+
+    def alive(self) -> bool:
+        return (not self._closed and self._proc is not None
+                and self._proc.is_alive())
+
+    def queue_depth(self) -> int:
+        """Outstanding descriptor-queue entries (health plane)."""
+        if self._desc_q is None:
+            return 0
+        with suppress(NotImplementedError, OSError):
+            return int(self._desc_q.qsize())
+        return 0
+
+    def close(self) -> None:
+        """Stop the worker, reap the drain thread, drop the mapping.
+        Idempotent; safe even if the worker was already killed. The
+        arena name was unlinked at attach time, so this only closes
+        our mapping — the kernel frees the memory with the last map."""
+        self._closed = True
+        if self._desc_q is not None:
+            with suppress(Exception):
+                self._desc_q.put(("stop",))
+        if self._proc is not None:
+            self._proc.join(timeout=3.0)
+            if self._proc.is_alive():
+                self._proc.terminate()
+                self._proc.join(timeout=3.0)
+        if self._done_q is not None:
+            with suppress(Exception):
+                self._done_q.put(None)  # wake + retire the drain thread
+        if self._drain is not None:
+            self._drain.join(timeout=3.0)
+            self._drain = None
+        if self._shm is not None:
+            self._unlink()
+            # dangling slot views (exported memoryview slices a caller
+            # dropped without releasing) keep the mmap pinned; collect
+            # them now so neither close() nor the eventual __del__
+            # trips BufferError on exported pointers
+            gc.collect()
+            with suppress(BufferError):
+                self._shm.close()
+            self._shm = None
+        flight.record("aggd.close", lane=self._lane,
+                      fused_rounds=self.fused_rounds,
+                      fallbacks=self.fallbacks,
+                      bytes_ingested=self.bytes_ingested)
+
+    def _unlink(self) -> None:
+        if not self._unlinked and self._shm is not None:
+            self._unlinked = True
+            with suppress(FileNotFoundError):
+                self._shm.unlink()
+
+    # -- slots ----------------------------------------------------------
+    def lease(self, nbytes: int):
+        """Lease one slot for an ``nbytes`` payload. Returns
+        ``(slot, memoryview)`` sized to the payload, or None when the
+        caller must stay inline (no arena, exhausted, or oversized)."""
+        if nbytes <= 0 or not self._ensure(nbytes):
+            return None
+        if nbytes > self.slot_bytes:
+            return None
+        with self._lock:
+            if not self._free:
+                return None
+            slot = self._free.pop()
+            self._leased.add(slot)
+        self.bytes_ingested += int(nbytes)
+        if self._tracer.enabled:
+            self._tracer.count("aggd_bytes_ingested", int(nbytes))
+        return slot, self.view(slot, nbytes)
+
+    def view(self, slot: int, length: int) -> memoryview:
+        off = slot * self.slot_bytes
+        return self._shm.buf[off: off + length]
+
+    def release(self, slot: int) -> None:
+        """Return a slot to the free list. No-op for slots not
+        currently leased, so teardown paths can release defensively."""
+        with self._lock:
+            if slot not in self._leased:
+                return
+            self._leased.discard(slot)
+            self._free.append(slot)
+        self.slot_releases += 1
+
+    # -- fuse -----------------------------------------------------------
+    async def fuse(self, entries, timeout_s: float = 60.0):
+        """Ship one fuse request: ``entries`` is a list of
+        ``("s", slot, length, weight)`` / ``("b", blob, weight)``
+        tuples (weights are the session's EFFECTIVE weights — staleness
+        and reputation already folded in). Returns
+        ``(result_slot, length, stats)`` — the caller decodes the
+        result slot and releases it — or None, meaning fall back to
+        in-process aggregation (worker dead/stalled/errored)."""
+        if self._closed or self._shm is None or not self.alive():
+            return None
+        with self._lock:
+            if not self._free:
+                return None
+            slot = self._free.pop()
+            self._leased.add(slot)
+        req_id = next(self._req_ids)
+        loop = asyncio.get_running_loop()
+        ev = asyncio.Event()
+        box: list = []
+        with self._lock:
+            self._waiters[req_id] = (loop, ev, box)
+            self._pending_result[req_id] = slot
+        try:
+            self._desc_q.put(("fuse", req_id, list(entries), slot))
+        except Exception:
+            with self._lock:
+                self._waiters.pop(req_id, None)
+                self._pending_result.pop(req_id, None)
+            self.release(slot)
+            return None
+        deadline = loop.time() + max(1.0, float(timeout_s))
+        while True:
+            with suppress(asyncio.TimeoutError):
+                await asyncio.wait_for(ev.wait(), timeout=0.25)
+            if ev.is_set():
+                break
+            if loop.time() > deadline or not self.alive():
+                worker_dead = not self.alive()
+                with self._lock:
+                    self._waiters.pop(req_id, None)
+                    if worker_dead:
+                        # nobody will ever write the result slot again
+                        self._pending_result.pop(req_id, None)
+                    # else: leave it pending — the drain thread
+                    # reclaims the slot when the late reply lands
+                if worker_dead:
+                    self.release(slot)
+                return None
+        with self._lock:
+            self._pending_result.pop(req_id, None)
+        item = box[0]
+        if item[0] == "err":
+            flight.record("aggd.error", lane=self._lane, error=item[2])
+            self.release(slot)
+            return None
+        _, _, length, stats = item
+        self.fused_rounds += 1
+        return slot, int(length), stats
+
+    def _drain_loop(self) -> None:
+        """Done-queue pump (plain thread, not a task: the reply arrives
+        from another process and must not depend on loop liveness).
+        Resolves fuse waiters via call_soon_threadsafe."""
+        while True:
+            try:
+                item = self._done_q.get()
+            except Exception:
+                break
+            if item is None:
+                break
+            if item[0] == "attached":
+                # both mappings exist from here on: unlink the name so
+                # /dev/shm is clean even under SIGKILL
+                self._unlink()
+                continue
+            req_id = item[1]
+            with self._lock:
+                waiter = self._waiters.pop(req_id, None)
+                abandoned = (self._pending_result.pop(req_id, None)
+                             if waiter is None else None)
+            if waiter is None:
+                # timed-out request: the worker is done writing, so its
+                # result slot is finally safe to reuse
+                if abandoned is not None:
+                    self.release(abandoned)
+                continue
+            loop, ev, box = waiter
+            box.append(item)
+            with suppress(RuntimeError):  # loop closed at teardown
+                loop.call_soon_threadsafe(ev.set)

@@ -29,6 +29,7 @@ import time
 
 from p2pfl_tpu.config.schema import ScenarioConfig
 from p2pfl_tpu.core.aggregators import get_aggregator
+from p2pfl_tpu.p2p.aggd import SidecarClient
 from p2pfl_tpu.datasets import FederatedDataset
 from p2pfl_tpu.learning import JaxLearner
 from p2pfl_tpu.models.base import build_model
@@ -126,6 +127,19 @@ def _declares_full_mesh(cfg) -> bool:
     )
 
 
+def _aggd_status(client: SidecarClient | None) -> dict:
+    """Sidecar gauges for a status record: descriptor-queue depth vs
+    slot releases is the pair the sidecar-stalled health rule compares,
+    bytes_ingested is the live zero-copy-ingest odometer."""
+    if client is None:
+        return {}
+    return {
+        "aggd_desc_q_depth": client.queue_depth(),
+        "aggd_slot_releases": client.slot_releases,
+        "aggd_bytes_ingested": client.bytes_ingested,
+    }
+
+
 def _free_ports(n: int) -> list[int]:
     socks, ports = [], []
     for _ in range(n):
@@ -142,7 +156,8 @@ async def _run_node(cfg: ScenarioConfig, idx: int, ports: list[int],
                     tls_dir: str | None = None,
                     hosts: list[str] | None = None,
                     bind: str = "127.0.0.1",
-                    resume: bool = False) -> dict:
+                    resume: bool = False,
+                    sidecar: SidecarClient | None = None) -> dict:
     """One node's full lifecycle (node_start.py main analog).
 
     ``hosts`` gives each node's reachable address (container service
@@ -196,6 +211,7 @@ async def _run_node(cfg: ScenarioConfig, idx: int, ports: list[int],
         checkpoint_every=cfg.checkpoint_every,
         resume=resume,
         joiner=resume,
+        sidecar=sidecar,
         **adv_kwargs,
     )
     await node.start()
@@ -243,7 +259,8 @@ async def _run_node(cfg: ScenarioConfig, idx: int, ports: list[int],
                      # one-sided (json turns the int keys into strings)
                      "peer_bytes_in": dict(node.peer_bytes_in),
                      "peer_bytes_out": dict(node.peer_bytes_out),
-                     "recompiles": obs_trace.xla_recompiles()},
+                     "recompiles": obs_trace.xla_recompiles(),
+                     **_aggd_status(sidecar)},
                 )
                 await asyncio.sleep(cfg.protocol.heartbeat_period_s)
 
@@ -312,11 +329,23 @@ def node_main(config_path: str, idx: int | list[int], ports: list[int],
         setup_node_logging(cfg.log_dir, cfg.name, idxs[0])
         log_environment()
 
+    # one sidecar per OS process: every node sharing this event loop
+    # lands payloads into the same shared-memory arena (the per-HOST
+    # deployment shape — each host runs its own aggd). Sizing: each of
+    # this process's sessions holds up to n_nodes payload slots for the
+    # whole round (full mesh, entries pinned until the fuse) plus a
+    # result slot; +8 margin for in-flight reads
+    sidecar = None
+    if cfg.aggregation_plane == "sidecar":
+        sidecar = SidecarClient(
+            n_slots=len(idxs) * (cfg.n_nodes + 2) + 8)
+
     async def _run_all() -> list[dict]:
         return list(
             await asyncio.gather(
                 *(_run_node(cfg, i, ports, tls_dir=tls_dir,
-                            hosts=hosts, bind=bind, resume=resume)
+                            hosts=hosts, bind=bind, resume=resume,
+                            sidecar=sidecar)
                   for i in idxs)
             )
         )
@@ -330,6 +359,9 @@ def node_main(config_path: str, idx: int | list[int], ports: list[int],
         flight.record("proc.exception", nodes=idxs, error=repr(e))
         flight.dump(f"proc{idxs[0]}.exception")
         raise
+    finally:
+        if sidecar is not None:
+            sidecar.close()
     if tracer.enabled:
         # one file per OS process; nodes sharing this event loop are
         # separated by lane inside it (traceview merges across files)
@@ -361,6 +393,14 @@ async def _simulate(cfg: ScenarioConfig, timeout: float = 600) -> dict:
     adv_kwargs = [
         _node_adversary_kwargs(cfg, i, data, adv_setup) for i in range(n)
     ]
+    # one shared sidecar for the whole in-process federation (simulation
+    # mode models ONE host). Sizing: every session can hold up to n
+    # payload slots for the whole round (full mesh, entries pinned
+    # until the fuse) plus its result slot; +8 margin for in-flight
+    # reads — exhaustion degrades to blob entries, never to wrong math
+    sidecar = None
+    if cfg.aggregation_plane == "sidecar":
+        sidecar = SidecarClient(n_slots=n * (n + 2) + 8)
     nodes = [
         P2PNode(
             i,
@@ -381,6 +421,7 @@ async def _simulate(cfg: ScenarioConfig, timeout: float = 600) -> dict:
             local_epochs=cfg.nodes[i].epochs,
             checkpoint_dir=cfg.checkpoint_dir,
             checkpoint_every=cfg.checkpoint_every,
+            sidecar=sidecar,
             **adv_kwargs[i],
         )
         for i in range(n)
@@ -435,6 +476,7 @@ async def _simulate(cfg: ScenarioConfig, timeout: float = 600) -> dict:
             checkpoint_dir=cfg.checkpoint_dir,
             checkpoint_every=cfg.checkpoint_every,
             resume=resume,
+            sidecar=sidecar,
             **adv_kwargs[i],
         )
         nodes[i] = nd
@@ -484,7 +526,8 @@ async def _simulate(cfg: ScenarioConfig, timeout: float = 600) -> dict:
                      "bytes_out": nd.bytes_out,
                      "peer_bytes_in": dict(nd.peer_bytes_in),
                      "peer_bytes_out": dict(nd.peer_bytes_out),
-                     "recompiles": obs_trace.xla_recompiles()},
+                     "recompiles": obs_trace.xla_recompiles(),
+                     **_aggd_status(sidecar)},
                 )
 
         async def _status_loop() -> None:
@@ -592,6 +635,8 @@ async def _simulate(cfg: ScenarioConfig, timeout: float = 600) -> dict:
             publish_pass()
         for node in nodes:
             await node.stop()
+        if sidecar is not None:
+            sidecar.close()
     accs = [
         m.get("accuracy") for m in
         (nd.peer_metrics.get(nd.idx) or {} for nd in nodes)
@@ -613,7 +658,16 @@ async def _simulate(cfg: ScenarioConfig, timeout: float = 600) -> dict:
         # encoded PARAMS blob bytes × targets — the wire-dtype A/B's
         # numerator, isolated from control-plane traffic
         "params_bytes_out": sum(nd.params_bytes_out for nd in nodes),
+        # payload bytes the event loop itself decoded/materialized on
+        # the round path — the aggregation-plane A/B's contrast metric
+        # (sidecar arm pins this at 0; inline arm pays it in full)
+        "loop_payload_touch_bytes": sum(
+            nd.loop_payload_touch_bytes for nd in nodes),
     }
+    if sidecar is not None:
+        out["aggd_bytes_ingested"] = sidecar.bytes_ingested
+        out["aggd_fused_rounds"] = sidecar.fused_rounds
+        out["aggd_fallbacks"] = sidecar.fallbacks
     if cfg.faults or el.active:
         # elasticity accounting: who crashed/re-joined, which nodes ran
         # slow, and whether the async close rule was on — the churn

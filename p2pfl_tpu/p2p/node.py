@@ -137,8 +137,9 @@ class P2PNode:
         checkpoint_dir: str | None = None,
         checkpoint_every: int = 0,
         resume: bool = False,
+        sidecar=None,
     ):
-        from p2pfl_tpu.p2p.session import AggregationSession
+        from p2pfl_tpu.p2p.session import AggregationSession, SidecarSession
 
         self.idx = idx
         self.learner = learner
@@ -270,12 +271,38 @@ class P2PNode:
         # active — applied at the next round boundary (jumping
         # self.round mid-round would desync the live session)
         self._join_round_target: int | None = None
-        self.session = AggregationSession(
-            aggregator, timeout_s=self.protocol.aggregation_timeout_s,
-            reputation=reputation, lane=self._lane,
-            min_received=el.min_received if el.async_aggregation else 1.0,
-            staleness_beta=el.staleness_beta if el.async_aggregation else 0.0,
-        )
+        # aggregation sidecar (round 16): ``sidecar`` is the host
+        # process's shared aggd.SidecarClient — when present, payload
+        # bytes bypass this loop entirely (protocol slot_sink → shm
+        # arena → sidecar fuse) and the session is the slot-native
+        # SidecarSession. ``loop_payload_touch_bytes`` counts every
+        # payload byte the ROUND PATH still materializes/decodes on
+        # the loop (the zero-copy pin asserts ≈0 under the sidecar;
+        # one-time init diffusion is bootstrap, not round path, and
+        # executor-side decodes never touch the loop).
+        self.sidecar = sidecar
+        self.loop_payload_touch_bytes = 0
+        if sidecar is not None:
+            self.session: AggregationSession = SidecarSession(
+                aggregator,
+                timeout_s=self.protocol.aggregation_timeout_s,
+                reputation=reputation, lane=self._lane,
+                min_received=el.min_received if el.async_aggregation
+                else 1.0,
+                staleness_beta=el.staleness_beta
+                if el.async_aggregation else 0.0,
+                client=sidecar, spawn=self._track_task,
+            )
+        else:
+            self.session = AggregationSession(
+                aggregator,
+                timeout_s=self.protocol.aggregation_timeout_s,
+                reputation=reputation, lane=self._lane,
+                min_received=el.min_received if el.async_aggregation
+                else 1.0,
+                staleness_beta=el.staleness_beta
+                if el.async_aggregation else 0.0,
+            )
         self.membership = Membership(
             n_nodes, self.protocol, virtual=False,
             retry_limit=el.heartbeat_retry_limit,
@@ -435,10 +462,27 @@ class P2PNode:
         self.peers.clear()
         if self._server:
             self._server.close()
+        self._release_slot_refs()
         self.finished.set()
         # postmortem: the crash is exactly the moment the ring's
         # churn history stops being reconstructible any other way
         flight.dump(f"node{self.idx}.crash")
+
+    def _release_slot_refs(self) -> None:
+        """Return every shm slot this node still references — buffered
+        future-round messages and the session's undecoded entries — to
+        the host's sidecar arena. Crash/stop teardown MUST route here:
+        a restarted node gets a fresh session, and slots stranded by
+        the old one would bleed the shared arena dry."""
+        if self.sidecar is None:
+            return
+        for _peer, msg in self._pending_params:
+            if msg._slot is not None:
+                self.sidecar.release(msg._slot)
+                msg._slot = None
+        release = getattr(self.session, "release_entries", None)
+        if release is not None:
+            release()
 
     # ------------------------------------------------------------------
     # partition control (round 14): the fault driver's scripted cut
@@ -546,6 +590,7 @@ class P2PNode:
             self._server.close()
             # NOT wait_closed(): on py3.12 it blocks until every peer
             # connection (including ones owned by other nodes) is gone
+        self._release_slot_refs()
 
     def _transport_idx(self, writer: asyncio.StreamWriter) -> int | None:
         """The node index the connection's TLS certificate vouches for
@@ -841,13 +886,40 @@ class P2PNode:
             tr.count(f"tx_msgs/{msg.type.value}")
 
     async def _read_loop(self, peer: PeerState, reader) -> None:
+        # with a sidecar, eligible PARAMS payloads land straight in the
+        # shm arena (read_message's slot_sink) — the loop sees only the
+        # header + a slot id, never the payload bytes
+        sink = self._slot_sink if self.sidecar is not None else None
         try:
             while True:
-                msg = await read_message(reader)
+                msg = await read_message(reader, slot_sink=sink)
                 self._count_rx(peer, msg)
                 await self._dispatch(peer, msg)
         except (asyncio.IncompleteReadError, ConnectionError, ValueError):
             self._drop_conn(peer)
+
+    def _slot_sink(self, obj: dict, pl: int):
+        """Divert decision for read_message: lease an arena slot for
+        this payload, or None to keep the heap-bytes path. Eligible:
+        unsigned PARAMS with contributor/weight metadata in the body
+        ("c"/"w" — all session bookkeeping runs off the header), not
+        init diffusion, not on a proxy (relays must re-ship the
+        payload), and not a full-model adoption while this session
+        waits (adoption decodes, so it stays on the heap)."""
+        if obj.get("t") != MsgType.PARAMS.value or obj.get("g"):
+            return None
+        body = obj.get("b") or {}
+        if body.get("init") or body.get("c") is None or body.get("w") is None:
+            return None
+        if self.role == "proxy":
+            return None
+        if self.session.waiting and body.get("aggregated"):
+            return None
+        lease = self.sidecar.lease(pl)
+        if lease is None:
+            return None  # arena exhausted/oversized: inline fallback
+        slot, mv = lease
+        return slot, mv, self.sidecar.release
 
     async def _dispatch(self, peer: PeerState, msg: Message) -> None:
         if not (0 <= msg.sender < self.n_nodes):
@@ -1019,6 +1091,61 @@ class P2PNode:
             if (self.session.async_mode and self._round_active
                     and not self.session.waiting
                     and not msg.body.get("aggregated")):
+                if msg._slot is not None:
+                    # slot-native stale fold: staleness discounts the
+                    # WEIGHT (params-agnostic), and the header's
+                    # "c"/"w" metadata is all the session needs —
+                    # the payload stays undecoded in the arena
+                    contribs = frozenset(
+                        int(c) for c in msg.body.get("c") or ())
+                    ts = self.session.train_set
+                    if contribs and not (ts and contribs >= ts):
+                        covered = self.session.add_slot(
+                            msg._slot, msg._slot_len, contribs,
+                            int(msg.body.get("w", 1)),
+                            staleness=staleness,
+                        )
+                        msg._slot = None  # session owns it now
+                        if self._tracer.enabled:
+                            self._tracer.count("stale_params_folded")
+                        if covered:
+                            await self.broadcast(
+                                Message(
+                                    MsgType.MODELS_AGGREGATED, self.idx,
+                                    {"contributors": sorted(covered),
+                                     "round": self.round},
+                                )
+                            )
+                        return
+                    self.sidecar.release(msg._slot)
+                    msg._slot = None
+                    return
+                if (self.sidecar is not None and "c" in msg.body
+                        and "w" in msg.body):
+                    # arena was exhausted at the sink: the payload is
+                    # loop-side bytes, but it still folds UNDECODED —
+                    # add_blob retries the lease or queues the blob
+                    contribs = frozenset(
+                        int(c) for c in msg.body.get("c") or ())
+                    ts = self.session.train_set
+                    if contribs and not (ts and contribs >= ts):
+                        covered = self.session.add_blob(
+                            msg.payload, contribs,
+                            int(msg.body.get("w", 1)),
+                            staleness=staleness,
+                        )
+                        if self._tracer.enabled:
+                            self._tracer.count("stale_params_folded")
+                        if covered:
+                            await self.broadcast(
+                                Message(
+                                    MsgType.MODELS_AGGREGATED, self.idx,
+                                    {"contributors": sorted(covered),
+                                     "round": self.round},
+                                )
+                            )
+                    return
+                self.loop_payload_touch_bytes += len(msg.payload)
                 payload = decode_parameters(msg.payload)
                 contribs = frozenset(payload.contributors)
                 ts = self.session.train_set
@@ -1037,13 +1164,55 @@ class P2PNode:
                                  "round": self.round},
                             )
                         )
+                return
+            if msg._slot is not None:
+                self.sidecar.release(msg._slot)
+                msg._slot = None
             return
         if self.session.waiting and not msg.body.get("aggregated"):
+            if msg._slot is not None:
+                self.sidecar.release(msg._slot)
+                msg._slot = None
             return  # waiting nodes adopt only a *finished* aggregate
-        payload = decode_parameters(msg.payload)
-        covered = self.session.add_model(
-            payload.params, payload.contributors, payload.weight
-        )
+        if msg._slot is not None:
+            if self.session.waiting:
+                # buffered-then-replayed aggregate meeting a session
+                # that turned waiting (e.g. voted out between rounds):
+                # adoption needs the decoded tree. Rare, counted — the
+                # zero-copy pin tolerates it only because the sink
+                # never diverts adoption payloads on the live path.
+                n = msg._slot_len
+                self.loop_payload_touch_bytes += n
+                blob = bytes(self.sidecar.view(msg._slot, n))
+                self.sidecar.release(msg._slot)
+                msg._slot = None
+                payload = decode_parameters(blob)
+                covered = self.session.add_model(
+                    payload.params, payload.contributors, payload.weight
+                )
+            else:
+                covered = self.session.add_slot(
+                    msg._slot, msg._slot_len,
+                    tuple(int(c) for c in msg.body.get("c") or ()),
+                    int(msg.body.get("w", 1)),
+                )
+                msg._slot = None  # session owns it now
+        elif (self.sidecar is not None and not self.session.waiting
+                and "c" in msg.body and "w" in msg.body):
+            # sink lease failed (arena momentarily exhausted): fold the
+            # raw blob without decoding — same undecoded plane, just
+            # via the descriptor queue instead of a slot
+            covered = self.session.add_blob(
+                msg.payload,
+                tuple(int(c) for c in msg.body.get("c") or ()),
+                int(msg.body.get("w", 1)),
+            )
+        else:
+            self.loop_payload_touch_bytes += len(msg.payload)
+            payload = decode_parameters(msg.payload)
+            covered = self.session.add_model(
+                payload.params, payload.contributors, payload.weight
+            )
         if covered:
             await self.broadcast(
                 Message(
@@ -1318,6 +1487,12 @@ class P2PNode:
         if not peers:
             return
         body.setdefault("round", self.round)
+        # contributor/weight metadata rides the HEADER too (round 16):
+        # a sidecar receiver runs its whole session bookkeeping —
+        # supersede/evict, quorum, staleness folds — off these fields
+        # without ever decoding the payload envelope
+        body["c"] = [int(c) for c in contributors]
+        body["w"] = int(weight)
         wd = self._wire_dtype_for(peers, init=bool(body.get("init")))
         if wd == "int8" and _ef:
             params = self._apply_error_feedback(params)
@@ -1766,6 +1941,10 @@ class P2PNode:
         for peer, msg in pending:
             if peer.idx in self.peers:
                 await self._on_params(peer, msg)
+            elif msg._slot is not None and self.sidecar is not None:
+                # the sender is gone; return its buffered payload's slot
+                self.sidecar.release(msg._slot)
+                msg._slot = None
         if role in ("aggregator", "server"):
             ref = (self.learner.get_parameters()
                    if self._poisons_updates() else None)

@@ -157,6 +157,14 @@ class Message:
     # totals are comparable without re-encoding
     _wire_bytes: int = dataclasses.field(
         default=0, repr=False, compare=False)
+    # shm-slot backing (aggregation sidecar): when read_message's
+    # slot_sink diverted the payload into a shared-memory slot,
+    # ``payload`` is b"" and these name the leased slot + the payload
+    # length that landed there. The receiver owns the lease.
+    _slot: int | None = dataclasses.field(
+        default=None, repr=False, compare=False)
+    _slot_len: int = dataclasses.field(
+        default=0, repr=False, compare=False)
 
     def __post_init__(self):
         if not self.msg_id and self.type in GOSSIPED:
@@ -299,9 +307,55 @@ async def write_message(writer: asyncio.StreamWriter, msg: Message) -> None:
     await writer.drain()
 
 
-async def read_message(reader: asyncio.StreamReader) -> Message:
+#: _read_into fallback chunk: bounds the transient copies the public
+#: StreamReader API forces when the internal buffer is empty
+_INTO_CHUNK = 1 << 18
+
+
+async def _read_into(reader: asyncio.StreamReader, mv: memoryview,
+                     n: int) -> None:
+    """Land exactly ``n`` stream bytes into ``mv`` without ever
+    materializing a contiguous n-byte object on the heap.
+
+    asyncio's StreamReader has no public readinto, so this drains the
+    reader's internal buffer by direct memcpy when it holds data
+    (``_buffer`` is a documented-stable bytearray in CPython; gated by
+    getattr so an exotic reader just takes the fallback), and falls
+    back to bounded ``read()`` chunks — transient copies of at most
+    _INTO_CHUNK bytes each, never the full payload — otherwise."""
+    buf = getattr(reader, "_buffer", None)
+    resume = getattr(reader, "_maybe_resume_transport", None)
+    got = 0
+    while got < n:
+        if isinstance(buf, bytearray) and len(buf):
+            take = min(len(buf), n - got)
+            with memoryview(buf) as bmv:
+                mv[got: got + take] = bmv[:take]
+            del buf[:take]
+            if resume is not None:
+                resume()
+            got += take
+            continue
+        # buffer empty/unavailable: wait for data (bounded chunk copy)
+        chunk = await reader.read(min(n - got, _INTO_CHUNK))
+        if not chunk:
+            raise asyncio.IncompleteReadError(bytes(mv[:got]), n)
+        mv[got: got + len(chunk)] = chunk
+        got += len(chunk)
+
+
+async def read_message(reader: asyncio.StreamReader,
+                       slot_sink=None) -> Message:
     """Read one frame; raises IncompleteReadError on EOF and ValueError
-    (loudly, never a misparse) on version skew or bogus lengths."""
+    (loudly, never a misparse) on version skew or bogus lengths.
+
+    ``slot_sink`` (aggregation sidecar) is consulted once the header is
+    parsed, as ``slot_sink(header_dict, payload_len)``. Returning
+    ``(slot, memoryview, release)`` diverts the payload bytes straight
+    into that shared-memory view via ``_read_into`` — the returned
+    Message then carries ``_slot``/``_slot_len`` and an EMPTY
+    ``payload``; a failed read releases the lease before re-raising.
+    Returning None keeps the normal heap-bytes path."""
     # one read for magic + header length: control frames dominate the
     # frame count (~400k per 24-node round pair), so awaits-per-frame
     # are a measured cost
@@ -318,6 +372,20 @@ async def read_message(reader: asyncio.StreamReader) -> Message:
     pl = int(obj.get("pl", 0))
     if pl < 0 or pl > MAX_FRAME:
         raise ValueError(f"peer announced bad payload length: {pl}")
+    if pl and slot_sink is not None:
+        lease = slot_sink(obj, pl)
+        if lease is not None:
+            slot, dst, on_error = lease
+            try:
+                await _read_into(reader, dst, pl)
+            except BaseException:
+                on_error(slot)
+                raise
+            msg = Message._from_header(obj, b"")
+            msg._slot = slot
+            msg._slot_len = pl
+            msg._wire_bytes = len(pre) + hlen + pl
+            return msg
     # the ONE host-side copy of the payload on the receive path: the
     # socket read itself. The returned bytes object is handed to
     # serialize.unpack without further slicing.

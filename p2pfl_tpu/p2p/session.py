@@ -47,8 +47,14 @@ import numpy as np
 
 from p2pfl_tpu.core.aggregators import Aggregator, FedAvg
 from p2pfl_tpu.core.pytree import tree_stack
+from p2pfl_tpu.core.serialize import (
+    decode_parameters,
+    encode_parameters,
+    own_params,
+)
 from p2pfl_tpu.obs import flight
 from p2pfl_tpu.obs.trace import get_tracer
+from p2pfl_tpu.p2p.aggd import SlotEntry, fuse_numpy
 from p2pfl_tpu.parallel.federated import staleness_scale
 
 Params = Any
@@ -160,7 +166,10 @@ class AggregationSession:
         if not contrib:
             return ()
         if self.waiting:
-            self.result = (params, tuple(sorted(contrib)))
+            # owning-copy boundary: the adopted tree's leaves are views
+            # into the received wire blob — sever them so the blob is
+            # collectable once the learner holds the params
+            self.result = (own_params(params), tuple(sorted(contrib)))
             self.done.set()
             return tuple(sorted(contrib))
         if contrib <= self.covered:
@@ -177,6 +186,7 @@ class AggregationSession:
         if (contrib & self.covered) - explained:
             return ()  # overlapping but not superseding — reject
         for key in evict:
+            self._evict_entry(self.models[key])
             del self.models[key]
         self.models[contrib] = (params, float(weight))
         self._partial_memo.clear()  # store changed; memoed partials stale
@@ -190,6 +200,11 @@ class AggregationSession:
                               quorum=self.quorum())
             self._finish()
         return tuple(sorted(self.covered))
+
+    def _evict_entry(self, entry) -> None:
+        """Hook: ``entry`` (a ``(params, weight)`` tuple) is about to
+        be superseded out of the store. SidecarSession releases the
+        entry's shm slot here; the inline session has nothing to do."""
 
     # -- partial aggregation for a peer ----------------------------------
     def get_partial_aggregation(
@@ -255,7 +270,12 @@ class AggregationSession:
         params, contribs, _ = self._aggregate(
             list(self.models.values()), keys=keys
         )
-        self.result = (params, tuple(sorted(self.covered)))
+        # owning-copy boundary at session close: the multi-entry numpy
+        # result already owns its accumulators (free pass-through), but
+        # a single-entry round returns the stored tree as-is — its
+        # leaves still view the received wire blob, and adopting views
+        # would pin the whole blob for the life of the model
+        self.result = (own_params(params), tuple(sorted(self.covered)))
         flight.record("session.close", lane=self._lane,
                       entries=len(keys), covered=sorted(self.covered),
                       timed_out=self.timed_out())
@@ -292,26 +312,15 @@ class AggregationSession:
         # reductions compiles a fresh program per distinct stack
         # size mid-round (measured: ~450 compiles / 2 rounds on the
         # 24-node uncapped bench, ~30% of wall). A numpy weighted
-        # mean is shape-oblivious and stays off-device.
+        # mean is shape-oblivious and stays off-device. The kernel
+        # itself lives in p2p.aggd (fuse_numpy) so the sidecar worker
+        # runs the IDENTICAL code — tolerance-0 parity by sharing.
         with self._tracer.span(
             "session.aggregate", lane=self._lane,
             args={"path": "numpy_fast", "n": len(entries)},
         ):
-            total = float(weights.sum())
-            if total > 0:
-                wn = weights / total
-            else:  # tree_weighted_mean degenerate-case parity
-                wn = np.full_like(weights, 1.0 / len(entries))
-                total = float(len(entries))
-            trees = [jax.tree.map(np.asarray, p) for p, _ in entries]
-
-            def leaf(*xs):
-                acc = np.asarray(xs[0], np.float32) * wn[0]
-                for wi, x in zip(wn[1:], xs[1:]):
-                    acc += np.asarray(x, np.float32) * wi
-                return acc.astype(np.asarray(xs[0]).dtype)
-
-            return jax.tree.map(leaf, *trees), (), total
+            tree, total = fuse_numpy([p for p, _ in entries], weights)
+            return tree, (), total
 
     def clear(self) -> None:
         """Reset for the next round (aggregator.py:231-238)."""
@@ -323,3 +332,293 @@ class AggregationSession:
         self.result = None
         self.done = asyncio.Event()
         self._deadline = None
+
+
+class SidecarSession(AggregationSession):
+    """AggregationSession with the payload plane delegated to the
+    shared-memory sidecar (p2p.aggd). node.py drives both session kinds
+    through the same calls — set_nodes_to_aggregate / add_model /
+    check_and_run / ``done`` + ``result`` — plus ``add_slot`` for
+    payloads the protocol reader landed straight into the arena.
+
+    Payload-plane differences from the base class:
+
+    - entries are ``SlotEntry`` markers (undecoded payload bytes in
+      the arena) or raw wire blobs (lease-failure fallback), never
+      decoded trees: the event loop never touches payload bytes;
+    - ``_finish`` ships the fuse request to the sidecar process and
+      completes asynchronously — ``check_and_run`` reports False while
+      the fuse is in flight so the node's gossip loop keeps ticking
+      until ``done`` actually sets. The store is frozen once the fuse
+      starts (late entries are rejected; their slots release), so the
+      fused set and ``covered`` cannot diverge mid-flight;
+    - reputation ``entry_scales`` still shape the effective weights
+      (computed here, applied inside the worker's weighted mean), but
+      ``observe_entries`` is skipped: scoring needs decoded trees,
+      which this plane never has on the loop. config.schema refuses
+      ``adversary.reputation`` + sidecar for exactly this reason;
+    - partial gossip serves only the node's OWN model: the schema
+      pins the sidecar plane to a fully-connected topology, where
+      every contributor's update already reached every aggregator
+      directly and re-forwarding third-party bytes is duplication;
+    - a dead/stalled sidecar degrades loudly (``aggd.fallback`` flight
+      event) to in-process aggregation off the loop — same kernel,
+      same result, no round lost.
+    """
+
+    def __init__(self, aggregator: Aggregator | None = None,
+                 timeout_s: float = 60.0, reputation=None,
+                 lane: str | None = None, min_received: float = 1.0,
+                 staleness_beta: float = 0.0, client=None, spawn=None):
+        super().__init__(aggregator, timeout_s=timeout_s,
+                         reputation=reputation, lane=lane,
+                         min_received=min_received,
+                         staleness_beta=staleness_beta)
+        #: the host's shared aggd.SidecarClient (one per process)
+        self.client = client
+        #: task spawner with node._track_task's (coro, what) signature;
+        #: None = tests driving the session without a node
+        self._spawn = spawn
+        # the node's own trained model, kept decoded for partial gossip
+        self._own: tuple[Params, tuple[int, ...], float] | None = None
+        self._fusing = False
+        self._fuse_task = None
+
+    # -- adding models ---------------------------------------------------
+    def add_model(self, params: Params, contributors, weight: float,
+                  staleness: float = 0.0) -> tuple[int, ...]:
+        """Tree entry point — the node's OWN model (and the waiting
+        adoption path, which defers to the base class). The tree is
+        encoded into a leased slot so every fuse entry is slot-backed;
+        if the arena can't take it, the wire blob itself is stored and
+        ships to the worker through the descriptor queue."""
+        if self.waiting:
+            return super().add_model(params, contributors, weight,
+                                     staleness)
+        with self._tracer.span("session.add_model", lane=self._lane):
+            if staleness > 0.0 and self.staleness_beta > 0.0:
+                weight = float(weight) * float(
+                    staleness_scale(staleness, self.staleness_beta)
+                )
+            contrib = tuple(int(i) for i in contributors)
+            blob = encode_parameters(params, contrib, max(1, int(weight)))
+            entry: Any = blob
+            lease = self.client.lease(len(blob)) if self.client else None
+            if lease is not None:
+                slot, mv = lease
+                mv[: len(blob)] = blob
+                entry = SlotEntry(slot, len(blob))
+            covered = self._add_model(entry, contrib, weight)
+            if covered:
+                self._own = (params, contrib, float(weight))
+            elif isinstance(entry, SlotEntry):
+                self.client.release(entry.slot)
+            return covered
+
+    def add_slot(self, slot: int, length: int, contributors,
+                 weight: float, staleness: float = 0.0) -> tuple[int, ...]:
+        """Slot-backed add: the payload stays undecoded in the arena.
+        Takes ownership of the slot — a rejected entry's slot is
+        released here, an accepted one when its fuse (or clear/crash
+        cleanup) consumes it. Never valid on a waiting session (the
+        node routes adoption payloads through the decode path)."""
+        with self._tracer.span("session.add_model", lane=self._lane):
+            if staleness > 0.0 and self.staleness_beta > 0.0:
+                weight = float(weight) * float(
+                    staleness_scale(staleness, self.staleness_beta)
+                )
+            covered = self._add_model(SlotEntry(slot, length),
+                                      contributors, weight)
+            if not covered and self.client is not None:
+                self.client.release(slot)
+            return covered
+
+    def add_blob(self, blob, contributors, weight: float,
+                 staleness: float = 0.0) -> tuple[int, ...]:
+        """Raw-wire-blob add — the arena was exhausted when the socket
+        sink asked, so the payload arrived as loop-side bytes. It still
+        never gets decoded here: a lease retry may land it in a slot
+        freed since (rounds release in bursts), otherwise the blob
+        itself ships to the worker through the descriptor queue."""
+        with self._tracer.span("session.add_model", lane=self._lane):
+            if staleness > 0.0 and self.staleness_beta > 0.0:
+                weight = float(weight) * float(
+                    staleness_scale(staleness, self.staleness_beta)
+                )
+            contrib = tuple(int(i) for i in contributors)
+            entry: Any = bytes(blob)
+            lease = self.client.lease(len(blob)) if self.client else None
+            if lease is not None:
+                slot, mv = lease
+                mv[: len(blob)] = blob
+                entry = SlotEntry(slot, len(blob))
+            covered = self._add_model(entry, contrib, weight)
+            if not covered and isinstance(entry, SlotEntry):
+                self.client.release(entry.slot)
+            return covered
+
+    def _add_model(self, params, contributors, weight):
+        if not self.waiting and (self._fusing or self.done.is_set()):
+            # the round is closing: a late entry can't make this fuse,
+            # and mutating the store mid-fuse would let a superseding
+            # eviction release a slot the worker is still reading
+            return ()
+        return super()._add_model(params, contributors, weight)
+
+    def _evict_entry(self, entry) -> None:
+        p, _w = entry
+        if isinstance(p, SlotEntry) and self.client is not None:
+            self.client.release(p.slot)
+
+    # -- partial aggregation for a peer ----------------------------------
+    def get_partial_aggregation(self, peer_has):
+        """Own-model-only: stored third-party entries are undecoded
+        slots, and on the full mesh the schema enforces every one of
+        them already reached the peer directly from its origin."""
+        if self._own is None:
+            return None
+        params, contribs, weight = self._own
+        if {int(i) for i in peer_has} & set(contribs):
+            return None
+        return params, contribs, weight
+
+    # -- completion -------------------------------------------------------
+    def check_and_run(self) -> bool:
+        if self.done.is_set():
+            return True
+        if not self._fusing and self.models and (
+            (self.train_set and self.covered >= self.train_set)
+            or (self.async_mode and self.quorum_met())
+            or self.timed_out()
+        ):
+            self._finish()
+        # while the fuse is in flight this stays False — the gossip
+        # loop keeps ticking until the result actually publishes
+        return self.done.is_set()
+
+    def _finish(self) -> None:
+        if self._fusing or self.done.is_set():
+            return
+        self._fusing = True
+        keys = list(self.models.keys())
+        entries = list(self.models.values())
+        covered = tuple(sorted(self.covered))
+        weights = np.asarray([w for _, w in entries], np.float32)
+        if self.reputation is not None:
+            # entry_scales apply; observe_entries is structurally
+            # impossible here (undecoded entries) — see class doc
+            weights = weights * self.reputation.entry_scales(keys)
+        coro = self._fuse_and_close(entries, weights, covered)
+        if self._spawn is not None:
+            self._spawn(coro, "aggd_fuse")
+            return
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            # no loop (synchronous unit-test driver): fall back inline
+            coro.close()
+            params = self._fallback_fuse(entries, weights)
+            self._release_entries(entries)
+            self._publish(params, covered, len(entries))
+            return
+        self._fuse_task = loop.create_task(coro)
+
+    async def _fuse_and_close(self, entries, weights, covered) -> None:
+        loop = asyncio.get_running_loop()
+        n = len(entries)
+        req = []
+        for (p, _w), w in zip(entries, weights):
+            if isinstance(p, SlotEntry):
+                req.append(("s", p.slot, p.length, float(w)))
+            elif isinstance(p, (bytes, bytearray)):
+                req.append(("b", bytes(p), float(w)))
+            else:  # decoded tree (shouldn't occur; belt and braces)
+                req.append(("b", encode_parameters(p, (), 1), float(w)))
+        out = None
+        if self.client is not None:
+            out = await self.client.fuse(
+                req, timeout_s=max(5.0, self.timeout_s))
+        if out is not None:
+            slot, length, _stats = out
+            with self._tracer.span(
+                "session.fuse", lane=self._lane,
+                args={"path": "sidecar", "n": n},
+            ):
+                try:
+                    payload = await loop.run_in_executor(
+                        None, _decode_owned,
+                        self.client.view(slot, length))
+                finally:
+                    self.client.release(slot)
+            params = payload.params
+        else:
+            if self.client is not None:
+                self.client.fallbacks += 1
+            flight.record("aggd.fallback", lane=self._lane, entries=n)
+            params = await loop.run_in_executor(
+                None, self._fallback_fuse, entries, weights)
+        self._release_entries(entries)
+        self._publish(params, covered, n)
+
+    def _fallback_fuse(self, entries, weights):
+        """In-process fuse over the session's own entries — same
+        kernel (aggd.fuse_numpy), run off-loop, used when the sidecar
+        is dead/stalled or there is no loop at all."""
+        trees = []
+        for p, _w in entries:
+            if isinstance(p, SlotEntry):
+                trees.append(_decode_owned(
+                    self.client.view(p.slot, p.length)).params)
+            elif isinstance(p, (bytes, bytearray)):
+                trees.append(decode_parameters(p).release().params)
+            else:
+                trees.append(p)
+        if len(trees) == 1:
+            return trees[0]  # _aggregate's n==1 short-circuit parity
+        tree, _total = fuse_numpy(trees, weights)
+        return tree
+
+    def _release_entries(self, entries) -> None:
+        if self.client is None:
+            return
+        for p, _w in entries:
+            if isinstance(p, SlotEntry):
+                self.client.release(p.slot)
+        # the store still names these slots for coverage bookkeeping;
+        # null the markers so clear()/crash cleanup can't release a
+        # slot that another session has since re-leased
+        for k, (p, w) in list(self.models.items()):
+            if isinstance(p, SlotEntry):
+                self.models[k] = (None, w)
+
+    def _publish(self, params, covered, n_entries) -> None:
+        self.result = (own_params(params), covered)
+        flight.record("session.close", lane=self._lane,
+                      entries=n_entries, covered=list(covered),
+                      timed_out=self.timed_out(), plane="sidecar")
+        self.done.set()
+
+    def release_entries(self) -> None:
+        """Release every slot this session still holds — crash/stop
+        teardown and the pre-round clear() both route through here so
+        an interrupted round can't strand arena slots."""
+        if self.client is None:
+            return
+        for k, (p, w) in list(self.models.items()):
+            if isinstance(p, SlotEntry):
+                self.client.release(p.slot)
+                self.models[k] = (None, w)
+
+    def clear(self) -> None:
+        self.release_entries()
+        super().clear()
+        self._own = None
+        self._fusing = False
+        self._fuse_task = None
+
+
+def _decode_owned(blob):
+    """decode + sever in one executor hop: the returned payload's
+    leaves own their memory, so the shm slot (or blob) backing the
+    decode is immediately reusable."""
+    return decode_parameters(blob).release()

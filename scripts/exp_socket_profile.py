@@ -56,7 +56,7 @@ jax.config.update("jax_platforms", "cpu")
 
 
 def _cfg(rounds=3, gossip_period_s=0.05, gossip_fanout=6,
-         train_set_size=8):
+         train_set_size=8, aggregation_plane="inline"):
     from p2pfl_tpu.config.schema import (
         DataConfig,
         ProtocolConfig,
@@ -74,6 +74,7 @@ def _cfg(rounds=3, gossip_period_s=0.05, gossip_fanout=6,
                                 train_set_size=train_set_size,
                                 gossip_fanout=gossip_fanout,
                                 gossip_period_s=gossip_period_s),
+        aggregation_plane=aggregation_plane,
     )
 
 
@@ -120,11 +121,17 @@ def main() -> None:
     ap.add_argument("--multiproc", type=int, default=None, metavar="K",
                     help="run via p2p.launch with K nodes/process "
                          "instead of in-process simulation (no profile)")
+    ap.add_argument("--aggregator", choices=("inline", "sidecar"),
+                    default="inline",
+                    help="aggregation plane: 'sidecar' routes payloads "
+                         "through the shared-memory aggd process "
+                         "(docs/perf.md §16)")
     args = ap.parse_args()
 
     if args.multiproc:
         run_multiproc(args.multiproc, rounds=args.rounds,
-                      train_set_size=args.train_set_size)
+                      train_set_size=args.train_set_size,
+                      aggregation_plane=args.aggregator)
         return
 
     # ---- attribution run under cProfile ------------------------------
@@ -132,11 +139,15 @@ def main() -> None:
     t_cpu0 = time.process_time()
     prof.enable()
     out, wall = run_once(rounds=args.rounds,
-                         train_set_size=args.train_set_size)
+                         train_set_size=args.train_set_size,
+                         aggregation_plane=args.aggregator)
     prof.disable()
     cpu = time.process_time() - t_cpu0
-    print(f"baseline: round_s={out.get('round_s')} wall={wall:.1f}s "
-          f"process_cpu={cpu:.1f}s", flush=True)
+    print(f"baseline[{args.aggregator}]: round_s={out.get('round_s')} "
+          f"wall={wall:.1f}s process_cpu={cpu:.1f}s "
+          f"loop_payload_touch_bytes={out.get('loop_payload_touch_bytes')} "
+          f"aggd_bytes_ingested={out.get('aggd_bytes_ingested')}",
+          flush=True)
 
     stats = pstats.Stats(prof)
     buckets = {

@@ -1,0 +1,537 @@
+"""Shared-memory aggregation sidecar (p2p.aggd + SidecarSession).
+
+Covers the round-16 acceptance gates: tolerance-0 parity between the
+sidecar fuse and ``AggregationSession._aggregate_numpy`` (including
+reputation entry_scales and staleness folds), the zero-copy pin (the
+event loop touches 0 payload bytes on the sidecar plane), slot
+lease/release accounting under concurrent sessions, crash-to-fallback
+degradation, /dev/shm hygiene across crash + close, the serialize
+owning-copy boundary (wire blobs GC after a session closes), the
+schema refusal matrix, and the sidecar-stalled health rule.
+"""
+
+import asyncio
+import gc
+import glob
+import time
+import weakref
+
+import numpy as np
+import pytest
+
+from p2pfl_tpu.config.schema import (
+    DataConfig,
+    ElasticConfig,
+    FaultEvent,
+    ProtocolConfig,
+    ScenarioConfig,
+    TrainingConfig,
+)
+from p2pfl_tpu.core.serialize import decode_parameters, encode_parameters
+from p2pfl_tpu.obs import flight
+from p2pfl_tpu.p2p.aggd import SHM_PREFIX, SidecarClient, fuse_numpy
+from p2pfl_tpu.p2p.session import AggregationSession, SidecarSession
+
+
+def _tree(seed: int) -> dict:
+    rng = np.random.default_rng(seed)
+    return {
+        "w": rng.normal(size=(6, 4)).astype(np.float32),
+        "b": rng.normal(size=(4,)).astype(np.float32),
+    }
+
+
+def _shm_residue() -> list:
+    return glob.glob(f"/dev/shm/{SHM_PREFIX}*")
+
+
+class _Rep:
+    """reputation stub: entry_scales only (no reference is ever set, so
+    observe_entries is structurally unreachable in both arms)."""
+
+    def __init__(self, scales: dict):
+        self.scales = scales
+
+    def entry_scales(self, keys) -> np.ndarray:
+        return np.asarray(
+            [self.scales.get(frozenset(k), 1.0) for k in keys], np.float32
+        )
+
+
+def _leaves_equal(a, b) -> bool:
+    import jax
+
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    return len(la) == len(lb) and all(
+        np.array_equal(np.asarray(x), np.asarray(y))
+        for x, y in zip(la, lb)
+    )
+
+
+# ---------------------------------------------------------------------
+# parity: sidecar fuse == inline _aggregate_numpy, tolerance 0
+# ---------------------------------------------------------------------
+
+def _inline_result(trees, rep):
+    """The inline plane over pre-decoded trees: entry_scales and the
+    staleness discount fold exactly as in a live round."""
+    s = AggregationSession(timeout_s=30.0, reputation=rep,
+                          staleness_beta=0.5)
+    s.set_nodes_to_aggregate([0, 1, 2])
+    s.add_model(trees[0], (0,), 2)
+    s.add_model(trees[1], (1,), 3, staleness=2.0)
+    s.add_model(trees[2], (2,), 5)
+    assert s.check_and_run()
+    return s.result
+
+
+def test_sidecar_fuse_parity_with_inline_tolerance_zero():
+    """End-to-end through the REAL worker process: same blobs, same
+    effective weights (reputation scale on entry 1, staleness discount
+    on entry 1) must produce bit-identical leaves — the kernel is
+    shared (fuse_numpy), so any drift means the weight folding or the
+    encode/decode hop diverged."""
+    blobs = [encode_parameters(_tree(i), (i,), 1) for i in range(3)]
+    trees = [decode_parameters(b).params for b in blobs]
+    rep = _Rep({frozenset({1}): 0.5})
+    want, want_cov = _inline_result(trees, rep)
+
+    async def run():
+        client = SidecarClient(n_slots=8)
+        try:
+            s = SidecarSession(timeout_s=30.0, reputation=rep,
+                               staleness_beta=0.5, client=client)
+            s.set_nodes_to_aggregate([0, 1, 2])
+            s.add_model(trees[0], (0,), 2)
+            for i, (w, stale) in ((1, (3, 2.0)), (2, (5, 0.0))):
+                lease = client.lease(len(blobs[i]))
+                assert lease is not None
+                slot, mv = lease
+                mv[: len(blobs[i])] = blobs[i]
+                mv.release()
+                s.add_slot(slot, len(blobs[i]), (i,), w, staleness=stale)
+            deadline = time.monotonic() + 20
+            while not s.check_and_run():
+                assert time.monotonic() < deadline, "fuse never completed"
+                await asyncio.sleep(0.01)
+            assert client.fallbacks == 0, "parity must go through aggd"
+            assert client.fused_rounds == 1
+            return s.result
+        finally:
+            client.close()
+
+    got, got_cov = asyncio.run(run())
+    assert got_cov == want_cov == (0, 1, 2)
+    assert _leaves_equal(got, want)
+
+
+def test_fallback_fuse_parity_and_single_entry_shortcircuit():
+    """A dead client degrades to _fallback_fuse — same kernel, same
+    result; and one entry comes back as-is (the _aggregate n==1
+    short-circuit both planes mirror)."""
+    blobs = [encode_parameters(_tree(10 + i), (i,), 1) for i in range(2)]
+    trees = [decode_parameters(b).params for b in blobs]
+
+    s = SidecarSession(timeout_s=30.0, client=None)  # no client at all
+    s.set_nodes_to_aggregate([0, 1])
+    s.add_model(trees[0], (0,), 1)
+    s.add_model(trees[1], (1,), 4)
+    assert s.check_and_run()  # no loop -> synchronous fallback path
+    want, _ = fuse_numpy(trees, np.asarray([1.0, 4.0], np.float32))
+    assert _leaves_equal(s.result[0], want)
+
+    one = SidecarSession(timeout_s=30.0, client=None)
+    one.set_nodes_to_aggregate([0])
+    one.add_model(trees[0], (0,), 7)
+    assert one.check_and_run()
+    assert _leaves_equal(one.result[0], trees[0])
+
+
+# ---------------------------------------------------------------------
+# slot accounting: lease/release under concurrent sessions
+# ---------------------------------------------------------------------
+
+def test_slot_lease_release_under_concurrent_sessions():
+    """Two sessions share one client's arena concurrently; every
+    payload slot and both result slots must be back on the free list
+    once both rounds close, and an exhausted arena leases None (the
+    caller's stay-inline signal), never raises."""
+    blobs = {
+        i: encode_parameters(_tree(20 + i), (i,), 1) for i in range(4)
+    }
+
+    async def run():
+        client = SidecarClient(n_slots=6)
+        try:
+            async def one_round(own: int, peer: int):
+                s = SidecarSession(timeout_s=30.0, client=client)
+                s.set_nodes_to_aggregate([own, peer])
+                s.add_model(decode_parameters(blobs[own]).params,
+                            (own,), 1)
+                lease = client.lease(len(blobs[peer]))
+                assert lease is not None
+                slot, mv = lease
+                mv[: len(blobs[peer])] = blobs[peer]
+                mv.release()
+                s.add_slot(slot, len(blobs[peer]), (peer,), 2)
+                deadline = time.monotonic() + 20
+                while not s.check_and_run():
+                    assert time.monotonic() < deadline
+                    await asyncio.sleep(0.01)
+                return s
+
+            await asyncio.gather(one_round(0, 1), one_round(2, 3))
+            assert client.fused_rounds == 2 and client.fallbacks == 0
+            with client._lock:
+                assert len(client._free) == client.n_slots
+                assert not client._leased
+            # exhaustion: drain the arena -> next lease is None
+            held = []
+            while True:
+                lease = client.lease(1024)
+                if lease is None:
+                    break
+                held.append(lease[0])
+            assert len(held) == client.n_slots
+            for slot in held:
+                client.release(slot)
+            with client._lock:
+                assert len(client._free) == client.n_slots
+        finally:
+            client.close()
+
+    asyncio.run(run())
+
+
+def test_sidecar_worker_killed_mid_round_falls_back():
+    """SIGTERM the worker while a session holds slot entries: the fuse
+    must detect death fast (<= a few poll ticks), fall back in-process
+    with the identical kernel, count the fallback, and record the loud
+    flight event."""
+    blobs = [encode_parameters(_tree(30 + i), (i,), 1) for i in range(2)]
+    trees = [decode_parameters(b).params for b in blobs]
+
+    async def run():
+        client = SidecarClient(n_slots=6)
+        try:
+            s = SidecarSession(timeout_s=30.0, client=client)
+            s.set_nodes_to_aggregate([0, 1])
+            s.add_model(trees[0], (0,), 1)
+            lease = client.lease(len(blobs[1]))
+            slot, mv = lease
+            mv[: len(blobs[1])] = blobs[1]
+            mv.release()
+            # worker is up (lease spawned it); kill it before the fuse
+            client._proc.terminate()
+            client._proc.join(timeout=5.0)
+            flight.get_recorder().clear()
+            t0 = time.monotonic()
+            s.add_slot(slot, len(blobs[1]), (1,), 4)
+            deadline = time.monotonic() + 20
+            while not s.check_and_run():
+                assert time.monotonic() < deadline
+                await asyncio.sleep(0.01)
+            assert time.monotonic() - t0 < 5.0, "death detection too slow"
+            assert client.fallbacks == 1
+            assert flight.get_recorder().events("aggd.fallback")
+            want, _ = fuse_numpy(trees, np.asarray([1.0, 4.0], np.float32))
+            assert _leaves_equal(s.result[0], want)
+            with client._lock:
+                assert len(client._free) == client.n_slots
+        finally:
+            client.close()
+
+    asyncio.run(run())
+
+
+def test_no_shm_residue_while_running_or_after_close():
+    """The early-unlink handshake: once the worker attaches, the arena
+    NAME is gone from /dev/shm while both mappings stay usable — so
+    even SIGKILL on both processes leaks nothing. close() is idempotent
+    and leaves no residue either."""
+    assert not _shm_residue()
+
+    async def run():
+        client = SidecarClient(n_slots=4)
+        blob = encode_parameters(_tree(40), (0,), 1)
+        lease = client.lease(len(blob))
+        assert lease is not None
+        slot, mv = lease
+        mv[: len(blob)] = blob
+        mv.release()
+        # wait for the attach handshake to trigger the early unlink
+        deadline = time.monotonic() + 10
+        while not client._unlinked and time.monotonic() < deadline:
+            await asyncio.sleep(0.05)
+        assert client._unlinked and not _shm_residue()
+        # mapping still fully usable after the unlink
+        out = await client.fuse([("s", slot, len(blob), 1.0)],
+                                timeout_s=10.0)
+        assert out is not None
+        rslot, length, _stats = out
+        got = decode_parameters(bytes(client.view(rslot, length)))
+        assert _leaves_equal(got.params, _tree(40))
+        client.release(rslot)
+        client.release(slot)
+        client.close()
+        client.close()  # idempotent
+
+    asyncio.run(run())
+    assert not _shm_residue()
+
+
+# ---------------------------------------------------------------------
+# end-to-end federations (shared A/B fixture keeps the suite's wall
+# clock down: one sidecar run + one inline run serve several asserts)
+# ---------------------------------------------------------------------
+
+def _sim_cfg(plane: str, **over) -> ScenarioConfig:
+    kw = dict(
+        name=f"aggd-{plane}", n_nodes=4, topology="fully",
+        data=DataConfig(dataset="mnist", samples_per_node=30),
+        training=TrainingConfig(rounds=2, epochs_per_round=1,
+                                learning_rate=0.05),
+        protocol=ProtocolConfig(heartbeat_period_s=0.5,
+                                aggregation_timeout_s=30.0,
+                                vote_timeout_s=10.0, train_set_size=4,
+                                gossip_fanout=3),
+        aggregation_plane=plane,
+    )
+    kw.update(over)
+    return ScenarioConfig(**kw)
+
+
+@pytest.fixture(scope="module")
+def sim_ab():
+    from p2pfl_tpu.p2p.launch import run_simulation
+
+    sidecar = run_simulation(_sim_cfg("sidecar"), timeout=150)
+    inline = run_simulation(_sim_cfg("inline", name="aggd-inline"),
+                            timeout=150)
+    return sidecar, inline
+
+
+def test_zero_copy_pin_and_same_seed_accuracy(sim_ab):
+    """THE acceptance gate: on the sidecar arm the event loop decodes/
+    materializes 0 payload bytes on the round path while the inline arm
+    pays the full freight; same seed, identical accuracy; every fuse
+    went through the worker (no silent fallbacks)."""
+    sidecar, inline = sim_ab
+    assert sidecar["rounds"] == inline["rounds"] == 2
+    assert sidecar["loop_payload_touch_bytes"] == 0
+    assert inline["loop_payload_touch_bytes"] > 0
+    assert sidecar["mean_accuracy"] == inline["mean_accuracy"]
+    assert sidecar["aggd_fallbacks"] == 0
+    assert sidecar["aggd_fused_rounds"] >= 2 * 4  # rounds x nodes
+    # every gossiped payload landed through the arena, not the loop
+    assert sidecar["aggd_bytes_ingested"] > 0
+
+
+def test_no_shm_residue_after_simulation(sim_ab):
+    del sim_ab  # both federations (and their clients) are closed now
+    assert not _shm_residue()
+
+
+def test_sidecar_crash_fault_converges_and_leaves_no_residue():
+    """A node crash mid-round on the sidecar plane: its slot refs are
+    released by crash(), the surviving quorum keeps closing rounds, the
+    crash-consistent restart re-enters on the SAME shared arena, and
+    nothing is stranded in /dev/shm afterwards."""
+    from p2pfl_tpu.p2p.launch import run_simulation
+
+    cfg = _sim_cfg(
+        "sidecar", name="aggd-crash",
+        training=TrainingConfig(rounds=3, epochs_per_round=1,
+                                learning_rate=0.05),
+        protocol=ProtocolConfig(heartbeat_period_s=0.3,
+                                aggregation_timeout_s=30.0,
+                                vote_timeout_s=10.0, node_timeout_s=2.0,
+                                train_set_size=4, gossip_fanout=3),
+        elastic=ElasticConfig(async_aggregation=True, min_received=0.5,
+                              staleness_beta=0.5),
+        faults=[FaultEvent(node=3, round=1, kind="crash"),
+                FaultEvent(node=3, round=2, kind="restart")],
+    )
+    out = run_simulation(cfg, timeout=150)
+    assert out["rounds"] == 3  # survivors AND the restart finished
+    assert out["churn"]["crashes"] == [3]
+    assert out["churn"]["restarted"] == [3]
+    assert out["loop_payload_touch_bytes"] == 0
+    assert not _shm_residue()
+
+
+def test_sidecar_dead_worker_federation_still_converges(monkeypatch):
+    """Every fuse refused (as if the worker died instantly every
+    round): the federation must still converge through the in-process
+    fallback — degraded, never wrong."""
+    from p2pfl_tpu.p2p.launch import run_simulation
+
+    async def no_fuse(self, entries, timeout_s=60.0):
+        return None
+
+    monkeypatch.setattr(SidecarClient, "fuse", no_fuse)
+    out = run_simulation(_sim_cfg("sidecar", name="aggd-nofuse"),
+                         timeout=150)
+    assert out["rounds"] == 2
+    assert out["mean_accuracy"] is not None
+    assert out["aggd_fallbacks"] >= 2 * 4
+    assert not _shm_residue()
+
+
+# ---------------------------------------------------------------------
+# serialize: owning-copy boundary / wire-blob GC-ability
+# ---------------------------------------------------------------------
+
+class _Blob(bytes):
+    """bytes subclass that can carry a canary attribute — bytes is a
+    var-sized type so it can't take weakrefs directly, but the canary's
+    lifetime IS the blob's lifetime."""
+
+
+class _Canary:
+    pass
+
+
+def _canary_blob(tree) -> tuple["_Blob", "weakref.ref"]:
+    blob = _Blob(encode_parameters(tree, (0,), 1))
+    blob.canary = _Canary()
+    return blob, weakref.ref(blob.canary)
+
+
+def test_wire_blob_collectable_after_release_and_session_close():
+    """decode_parameters leaves VIEW the wire blob; release() (and the
+    session-close owning-copy boundary that calls own_params) must
+    sever that so the blob is collectable the moment the round ends."""
+    blob, ref = _canary_blob(_tree(50))
+    payload = decode_parameters(blob)
+    del blob
+    gc.collect()
+    assert ref() is not None, "leaves must pin the blob while views live"
+    payload.release()
+    assert payload._source is None
+    gc.collect()
+    assert ref() is None, "release() must make the blob collectable"
+    leaf = np.asarray(payload.params["w"])
+    assert leaf.flags.owndata and _leaves_equal(payload.params, _tree(50))
+
+    # session close: result leaves never view the entry blobs
+    blob2, ref2 = _canary_blob(_tree(51))
+    s = AggregationSession(timeout_s=30.0)
+    s.set_nodes_to_aggregate([1])
+    p = decode_parameters(blob2)
+    del blob2
+    s.add_model(p.params, (1,), 1)
+    assert s.check_and_run()
+    result, _ = s.result
+    del p
+    gc.collect()
+    assert ref2() is None, "session result must own its leaves"
+    assert _leaves_equal(result, _tree(51))
+
+
+# ---------------------------------------------------------------------
+# schema refusal matrix + health rule
+# ---------------------------------------------------------------------
+
+@pytest.mark.parametrize("over", [
+    {"aggregator": "krum"},
+    {"federation": "CFL"},
+    {"federation": "SDFL"},
+    {"topology": "ring"},
+    {"encrypt": True},
+    {"aggregation_plane": "offload"},  # unknown plane
+])
+def test_schema_refuses_sidecar_incompatible_combinations(over):
+    with pytest.raises(ValueError):
+        _sim_cfg("sidecar", **over)
+
+
+def test_schema_refuses_sidecar_with_adversary_and_cross_device():
+    from p2pfl_tpu.config.schema import AdversaryConfig, CrossDeviceConfig
+
+    with pytest.raises(ValueError, match="adversary"):
+        _sim_cfg("sidecar", adversary=AdversaryConfig(reputation=True))
+    with pytest.raises(ValueError, match="cross_device"):
+        _sim_cfg("sidecar",
+                 cross_device=CrossDeviceConfig(n_clients=100,
+                                                clients_per_round=8))
+    # the inline plane composes with all of it — only sidecar refuses
+    assert _sim_cfg("inline", aggregator="krum").aggregator == "krum"
+
+
+def test_health_rule_sidecar_stalled_fires_and_clears():
+    """Delta-state rule: queue depth growing across evaluations while
+    slot releases sit flat fires; releases moving again clears. A
+    single deep snapshot (no baseline) must NOT fire."""
+    from p2pfl_tpu.obs.health import HealthEngine
+
+    eng = HealthEngine()
+    now = time.time()
+
+    def st(depth, rel, t):
+        return [{"node": 0, "ts": t, "round": 1,
+                 "aggd_desc_q_depth": depth, "aggd_slot_releases": rel}]
+
+    assert not eng.evaluate(st(6, 10, now), now=now)  # no baseline yet
+    alerts = eng.evaluate(st(9, 10, now + 1), now=now + 1)
+    assert [a.rule for a in alerts] == ["sidecar-stalled"]
+    assert alerts[0].node == 0
+    # releases move again -> the alert clears
+    assert not eng.evaluate(st(12, 25, now + 2), now=now + 2)
+    cleared = [t for t in eng.transitions if t["event"] == "clear"]
+    assert cleared and cleared[0]["rule"] == "sidecar-stalled"
+    # inline federations (no aggd fields) never fire the rule
+    eng2 = HealthEngine()
+    plain = [{"node": 0, "ts": now, "round": 1}]
+    assert not eng2.evaluate(plain, now=now)
+    assert not eng2.evaluate(plain, now=now + 1)
+
+
+# ---------------------------------------------------------------------
+# protocol: slot_sink diverts payload bytes off the loop
+# ---------------------------------------------------------------------
+
+def test_read_message_slot_sink_divert_and_error_release():
+    """The reader lands payload bytes straight into the sink's buffer
+    (payload stays b"", slot/length stamped); a truncated payload calls
+    the sink's on_error so the lease is returned before the raise."""
+    from p2pfl_tpu.p2p.protocol import Message, MsgType, read_message
+
+    payload = bytes(range(256)) * 8
+    msg = Message(MsgType.PARAMS, 3,
+                  {"round": 0, "c": [3], "w": 5}, payload)
+    frame = msg.encode()
+
+    async def run():
+        buf = bytearray(len(payload) + 64)
+        released = []
+
+        def sink(obj, pl):
+            assert obj["b"]["c"] == [3] and pl == len(payload)
+            return 7, memoryview(buf)[:pl], released.append
+
+        reader = asyncio.StreamReader()
+        reader.feed_data(frame)
+        reader.feed_eof()
+        got = await read_message(reader, slot_sink=sink)
+        assert got.payload == b"" and got._slot == 7
+        assert got._slot_len == len(payload)
+        assert bytes(buf[: len(payload)]) == payload
+        assert not released
+
+        # sink declines -> payload materializes inline as before
+        reader2 = asyncio.StreamReader()
+        reader2.feed_data(frame)
+        reader2.feed_eof()
+        got2 = await read_message(reader2, slot_sink=lambda o, n: None)
+        assert got2.payload == payload and got2._slot is None
+
+        # truncated payload: on_error returns the lease, then raises
+        reader3 = asyncio.StreamReader()
+        reader3.feed_data(frame[: len(frame) - 100])
+        reader3.feed_eof()
+        with pytest.raises(asyncio.IncompleteReadError):
+            await read_message(reader3, slot_sink=sink)
+        assert released == [7]
+
+    asyncio.run(run())

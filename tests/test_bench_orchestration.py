@@ -31,7 +31,7 @@ def test_zero_budget_still_emits_parseable_json():
     assert set(out["skipped_phases"]) == {
         "headline", "cifar16", "cpu8", "socket24", "comm", "socket_mp",
         "obs", "obs_health", "robust", "elastic", "cross_device",
-        "chaos", "vit32"
+        "chaos", "aggd", "vit32"
     }
     # the provenance stamp (round 12) rides the envelope even at zero
     # budget — a regression report must always name its commit
@@ -213,6 +213,31 @@ def test_chaos_phase_dry_run_emits_key_plan():
     planned = set(parts[0]["chaos_keys"])
     assert {"chaos_recovery_s", "chaos_final_accuracy",
             "chaos_clean_accuracy", "chaos_accuracy_gap"} <= planned
+    assert planned <= set(bench.BENCH_KEYS)
+
+
+def test_aggd_phase_dry_run_emits_key_plan():
+    """P2PFL_AGGD_DRY=1: the aggd phase must emit its planned key list
+    as one parseable part without touching jax — the round-15 analog
+    of the chaos dry-run hook."""
+    env = dict(os.environ, P2PFL_AGGD_DRY="1")
+    code = (f"import sys; sys.path.insert(0, {str(REPO)!r})\n"
+            "import bench; bench._phase_aggd()\n")
+    res = subprocess.run([sys.executable, "-c", code],
+                         capture_output=True, text=True, timeout=60,
+                         env=env, cwd=REPO)
+    assert res.returncode == 0, res.stderr[-500:]
+    sys.path.insert(0, str(REPO))
+    import bench
+
+    parts = [json.loads(line[len(bench._PART_TAG):])
+             for line in res.stdout.splitlines()
+             if line.startswith(bench._PART_TAG)]
+    assert len(parts) == 1 and parts[0]["aggd_dry"] is True
+    planned = set(parts[0]["aggd_keys"])
+    assert {"aggd_round_s_24node_uncapped",
+            "aggd_inline_round_s_24node_uncapped",
+            "aggd_loop_payload_touch_bytes", "aggd_speedup"} <= planned
     assert planned <= set(bench.BENCH_KEYS)
 
 

@@ -355,6 +355,12 @@ class CrossDeviceConfig:
     clients_per_round: int = 0  # K sampled per round
     cohort_size: int = 1  # clients per simulation slot (scan length)
     sampling: str = "uniform"  # uniform | weighted (by client data size)
+    # round-17 accumulation layout: "fused" folds each cohort's FedAvg
+    # contribution into a single [1, d] carry row in the fit epilogue;
+    # "unfused" keeps the round-13 [n_slots, d] reference (bit-identical
+    # by the tolerance-0 parity gate — this is a perf knob, not a
+    # semantics knob)
+    accumulate: str = "fused"
     seed: int = 0
 
     def __post_init__(self):
@@ -362,6 +368,11 @@ class CrossDeviceConfig:
             raise ValueError(
                 f"unknown sampling {self.sampling!r}; "
                 "have ('uniform', 'weighted')"
+            )
+        if self.accumulate not in ("fused", "unfused"):
+            raise ValueError(
+                f"unknown accumulate {self.accumulate!r}; "
+                "have ('fused', 'unfused')"
             )
         if self.n_clients < 0:
             raise ValueError(f"n_clients must be >= 0, got {self.n_clients}")

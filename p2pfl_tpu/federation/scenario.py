@@ -757,6 +757,7 @@ class CrossDeviceScenario(Observable):
             self.fns,
             epochs=config.training.epochs_per_round,
             exchange_dtype=self._exchange_dtype,
+            fused_accumulate=cd.accumulate == "fused",
         )
         self._round_fn = self.transport.compile_round(round_fn)
         self._eval_fn = self.transport.compile_eval(build_eval_fn(self.fns))

@@ -18,6 +18,7 @@ XLA program instead of N Lightning Trainers in N processes.
 from __future__ import annotations
 
 import dataclasses
+import math
 import time
 from typing import Any, Callable
 
@@ -25,6 +26,8 @@ import jax
 import jax.numpy as jnp
 import optax
 from flax import struct
+
+from p2pfl_tpu.ops import pallas_gemm
 
 from p2pfl_tpu.core.serialize import (
     check_parameters,
@@ -133,6 +136,59 @@ def make_step_fns(
         weight_decay if optimizer.lower() == "adamw" else 0.0,
         momentum_dtype=momentum_dtype,
     )
+    # plain SGD's update is a pure elementwise stream the Pallas
+    # sgd_accum kernel can fuse — one pass over params/trace/grads per
+    # step instead of optax's per-transform tree traversals. Only the
+    # exact optax.sgd chain (trace + scale_by_learning_rate; decay is
+    # already folded into explicit grads above) is replicated, so
+    # anything else keeps tx.update untouched.
+    fuse_sgd = optimizer.lower() == "sgd"
+
+    def _fused_sgd_step(st, grads, gate, on):
+        """Route SGD leaves the measured gate picks through the fused
+        Pallas stream. Returns None whenever the fusion does not apply
+        — unexpected optax state shape, or no leaf picked pallas
+        (always the case off-TPU, where the gate forces xla) — so the
+        caller falls back to the bit-identical ``tx.update`` path."""
+        opt_state = st.opt_state
+        if not (isinstance(opt_state, (tuple, list)) and len(opt_state) == 2
+                and hasattr(opt_state[0], "trace")
+                and hasattr(opt_state[0], "_replace")):
+            return None
+        plan = jax.tree.map(
+            lambda p: pallas_gemm.choose(
+                "sgd_accum",
+                ((math.prod(p.shape[:-1]) if p.ndim > 1 else 1,
+                  p.shape[-1] if p.ndim else 1),) * 2,
+                p.dtype,
+            ) == "pallas",
+            st.params,
+        )
+        if not any(jax.tree.leaves(plan)):
+            return None
+        # the federation gate folds into the learning rate: a
+        # gated-off node's update is exactly +/-0.0, keeping params
+        # bit-exact while momentum decays — the ``where`` semantics
+        # below without a second tree pass
+        lr_eff = learning_rate if gate is None else learning_rate * gate
+
+        def leaf(p, m, g, use_pallas):
+            if use_pallas:
+                return pallas_gemm.sgd_accum(p, m, g, lr_eff,
+                                             momentum=momentum)
+            # leaves the gate left on XLA replicate optax.sgd term by
+            # term: f32 trace update, uncast update scaled by -lr,
+            # stored trace cast to the accumulator dtype
+            m_new = g + momentum * m
+            u = m_new * -learning_rate
+            if on is not None:
+                u = jnp.where(on, u, jnp.zeros_like(u))
+            return (p + u).astype(p.dtype), m_new.astype(m.dtype)
+
+        out = jax.tree.map(leaf, st.params, opt_state[0].trace, grads, plan)
+        params, new_trace = jax.tree.transpose(
+            jax.tree.structure(st.params), jax.tree.structure((0, 0)), out)
+        return params, (opt_state[0]._replace(trace=new_trace), opt_state[1])
 
     def init(rng, sample_x) -> TrainState:
         params = model.init(rng, sample_x)
@@ -201,6 +257,7 @@ def make_step_fns(
             if explicit_decay:
                 grads = jax.tree.map(
                     lambda g, p: g + explicit_decay * p, grads, st.params)
+            on = None
             if gate is not None:
                 # zero grads AND updates instead of where-selecting whole
                 # trees afterward: params stay bit-exact for gated-off
@@ -212,11 +269,17 @@ def make_step_fns(
                 on = gate > 0
                 grads = jax.tree.map(
                     lambda g: jnp.where(on, g, jnp.zeros_like(g)), grads)
-            updates, opt_state = tx.update(grads, st.opt_state, st.params)
-            if gate is not None:
-                updates = jax.tree.map(
-                    lambda u: jnp.where(on, u, jnp.zeros_like(u)), updates)
-            params = optax.apply_updates(st.params, updates)
+            fused = (_fused_sgd_step(st, grads, gate, on)
+                     if fuse_sgd else None)
+            if fused is not None:
+                params, opt_state = fused
+            else:
+                updates, opt_state = tx.update(grads, st.opt_state, st.params)
+                if gate is not None:
+                    updates = jax.tree.map(
+                        lambda u: jnp.where(on, u, jnp.zeros_like(u)),
+                        updates)
+                params = optax.apply_updates(st.params, updates)
             st = st.replace(params=params, opt_state=opt_state,
                             step=st.step + 1)
             return (st, loss_sum + loss), None

@@ -9,6 +9,8 @@ bfloat16 compute.
 
 from __future__ import annotations
 
+import math
+
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
@@ -43,6 +45,13 @@ class PatchConv(nn.Module):
     dtype: jnp.dtype | None = None  # None = inherit x.dtype (nn.Conv
     # semantics — a drop-in must not silently downcast f32 inputs)
     param_dtype: jnp.dtype = jnp.float32
+    # which measured gate kind owns the GEMM: "patches" (conv1's
+    # small-contraction class — this module asks the gate itself) or
+    # "conv2" (round 17: big contractions, where SmallCNN asks the
+    # gate BEFORE instantiating — the XLA incumbent there is the
+    # grouped-conv lowering, not an XLA patches matmul, so the
+    # fallback lives outside this module)
+    gate_kind: str = "patches"
 
     @nn.compact
     def __call__(self, x):
@@ -66,8 +75,14 @@ class PatchConv(nn.Module):
         # the pooling pass, so the kernel saves nothing by absorbing
         # them.
         flat = patches.reshape(-1, cin * kh * kw)
-        if pallas_gemm.choose("patches", (flat.shape, wf.shape),
-                              dtype) == "pallas":
+        if self.gate_kind == "conv2":
+            # the gate already chose pallas upstream (SmallCNN measures
+            # patches+kernel against the grouped conv end to end);
+            # dgrad stays XLA inside conv2_matmul's VJP — §6.2 has it
+            # at its floor
+            out = pallas_gemm.conv2_matmul(flat, wf)
+        elif pallas_gemm.choose("patches", (flat.shape, wf.shape),
+                                dtype) == "pallas":
             out = pallas_gemm.patches_matmul(flat, wf)
         else:
             out = flat @ wf
@@ -130,9 +145,27 @@ class SmallCNN(nn.Module):
             # explicit name= keeps the param tree keyed Conv_N exactly
             # as nn.Conv auto-naming did, so pre-PatchConv checkpoints
             # still resume (the two modules share param shapes)
-            if x.shape[-1] * self.kernel ** 2 <= PATCH_CONV_MAX_CONTRACTION:
+            contraction = x.shape[-1] * self.kernel ** 2
+            if contraction <= PATCH_CONV_MAX_CONTRACTION:
                 x = PatchConv(c, k, dtype=self.dtype,
                               param_dtype=self.param_dtype,
+                              name=f"Conv_{i}")(x)
+            elif pallas_gemm.choose(
+                "conv2",
+                ((math.prod(x.shape[:-1]), contraction),
+                 (contraction, c), tuple(x.shape), k),
+                self.dtype,
+            ) == "pallas":
+                # big-contraction convs (conv2 of the LEAF CNN: K=800)
+                # whose grouped-conv lowering the gate MEASURED as
+                # slower than patches + the streamed Pallas GEMM end to
+                # end (including the 25× im2col inflation — the reason
+                # this is a measured gate, not a threshold). Same param
+                # tree either way, so init/apply taking different
+                # branches at different batch sizes is checkpoint-safe.
+                x = PatchConv(c, k, dtype=self.dtype,
+                              param_dtype=self.param_dtype,
+                              gate_kind="conv2",
                               name=f"Conv_{i}")(x)
             else:
                 x = nn.Conv(c, k, padding="SAME", dtype=self.dtype,

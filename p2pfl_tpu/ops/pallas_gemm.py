@@ -32,6 +32,21 @@ kernels are written 2-D):
   VMEM-stationary, producing ``dx`` and ``dw`` tiles from one pass
   over ``x`` and ``w`` (one HBM read of each instead of XLA's two
   independent GEMMs).
+- ``conv2_matmul`` (round 17): the same stationary-weight stream
+  recipe applied to conv2's ``[M, 800] @ [800, 64]`` patches GEMM
+  (fwd via ``stream_gemm``, wgrad via ``stream_wgrad`` with the
+  ragged-tile mask; dgrad stays XLA — §6.2 measures it AT its floor).
+  The gate's "conv2" kind measures the whole per-node conv end to
+  end — patch formation + kernel vs the grouped-conv lowering — so
+  the im2col memory inflation that sank whole-model XLA im2col
+  (scripts/exp_im2col.py) is priced into the decision.
+- ``sgd_accum`` (round 17): fused SGD(+momentum) update — and
+  optionally a weighted FedAvg accumulate — as one M-streamed
+  elementwise pass: params, momentum and grads are read once and the
+  new params/momentum (plus ``acc + w * p_new``) written back,
+  attacking the §6.4 "SGD state stream" overage (6.3 ms measured vs a
+  5.0 ms floor). Arithmetic replicates ``optax.sgd`` bit-for-bit
+  (same promotion order, accumulator-dtype cast last).
 
 Selection: every call site asks :func:`choose`, which measures the
 Pallas and XLA variants at the actual (vmapped) shape on the real
@@ -57,6 +72,8 @@ import jax.numpy as jnp
 __all__ = [
     "patches_matmul",
     "dense_matmul",
+    "conv2_matmul",
+    "sgd_accum",
     "stream_gemm",
     "stream_wgrad",
     "dense_bwd",
@@ -292,6 +309,160 @@ def dense_matmul(x, w, *, block_d: int = _BLOCK_D,
 
 
 # ---------------------------------------------------------------------------
+# conv2_matmul: stream_gemm fwd + stream_wgrad, XLA dgrad (conv2 hot path)
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def _conv2_mm(x, w, block_m, interpret):
+    return _stream_gemm(x, w, block_m, interpret)
+
+
+def _conv2_mm_fwd(x, w, block_m, interpret):
+    return _conv2_mm(x, w, block_m, interpret), (x, w)
+
+
+def _conv2_mm_bwd(block_m, interpret, res, g):
+    x, w = res
+    # dgrad stays XLA: §6.2 measures conv2's dgrad AT its derived
+    # floor (2.0 ms vs 2.0), so a kernel has nothing to win there —
+    # only fwd (5.9 vs 4.9) and wgrad (7.3 vs 4.9) are over-floor
+    dx = _dot(g, w, ((1,), (1,))).astype(x.dtype)
+    dw = _stream_wgrad(x, g, block_m, interpret).astype(w.dtype)
+    return dx, dw
+
+
+_conv2_mm.defvjp(_conv2_mm_fwd, _conv2_mm_bwd)
+
+
+def conv2_matmul(x, w, *, block_m: int = _BLOCK_M,
+                 interpret: bool | None = None):
+    """``x [M, K] @ w [K, N]`` for the conv2 shape class (K up to
+    ~1024 — one stationary VMEM tile pair, e.g. the LEAF CNN's
+    ``[M, 800] @ [800, 64]``): Pallas fwd and wgrad, XLA dgrad."""
+    if x.ndim != 2 or w.ndim != 2:
+        raise ValueError(f"2-D operands required, got {x.shape} @ {w.shape}")
+    return _conv2_mm(x, w, int(block_m), _interp(interpret))
+
+
+# ---------------------------------------------------------------------------
+# sgd_accum: fused SGD(+momentum) update + optional weighted accumulate
+# ---------------------------------------------------------------------------
+
+
+def _decayed_trace(m_ref, momentum):
+    # replicate optax.sgd's promotion order exactly: ``decay * trace``
+    # is a trace-dtype multiply (numpy weak typing casts the Python
+    # float down), THEN the f32 grad add promotes. Pallas evaluates
+    # narrow-dtype arithmetic in f32 WITHOUT the intermediate rounding,
+    # so round the product back to the trace dtype by hand — a
+    # bf16*bf16 product fits f32 exactly, making round-once identical
+    # to a native bf16 multiply.
+    decay = jnp.asarray(momentum, m_ref.dtype).astype(jnp.float32)
+    return (decay * m_ref[:].astype(jnp.float32)).astype(m_ref.dtype)
+
+
+def _sgd_kernel(p_ref, m_ref, g_ref, lr_ref, p_out, m_out, *, momentum):
+    # the accumulator-dtype cast applies to the STORED state only; the
+    # param update consumes the uncast f32 trace (optax semantics)
+    m_new = g_ref[:] + _decayed_trace(m_ref, momentum)
+    p_out[:] = (p_ref[:] + m_new * -lr_ref[0, 0]).astype(p_out.dtype)
+    m_out[:] = m_new.astype(m_out.dtype)
+
+
+def _sgd_accum_kernel(p_ref, m_ref, g_ref, lr_ref, acc_ref, w_ref,
+                      p_out, m_out, acc_out, *, momentum):
+    m_new = g_ref[:] + _decayed_trace(m_ref, momentum)
+    p_new = (p_ref[:] + m_new * -lr_ref[0, 0]).astype(p_out.dtype)
+    p_out[:] = p_new
+    m_out[:] = m_new.astype(m_out.dtype)
+    acc_out[:] = acc_ref[:] + w_ref[0, 0] * p_new.astype(jnp.float32)
+
+
+@functools.partial(jax.jit, static_argnums=(4, 5, 6))
+def _sgd(p, m, g, lr, momentum, block_m, interpret):
+    import jax.experimental.pallas as pl
+
+    rows, cols = p.shape
+    bm = min(block_m, rows)
+    tile = pl.BlockSpec((bm, cols), lambda i: (i, 0))
+    one = pl.BlockSpec((1, 1), lambda i: (0, 0))
+    # elementwise over rows: a ragged last tile only reads garbage into
+    # output rows the BlockSpec masks off on write — nothing crosses
+    # rows, so no operand masking is needed (unlike the wgrad reduce)
+    return pl.pallas_call(
+        functools.partial(_sgd_kernel, momentum=momentum),
+        grid=(pl.cdiv(rows, bm),),
+        in_specs=[tile, tile, tile, one],
+        out_specs=[tile, tile],
+        out_shape=[
+            jax.ShapeDtypeStruct(p.shape, p.dtype),
+            jax.ShapeDtypeStruct(m.shape, m.dtype),
+        ],
+        interpret=interpret,
+    )(p, m, g, lr)
+
+
+@functools.partial(jax.jit, static_argnums=(6, 7, 8))
+def _sgd_acc(p, m, g, lr, acc, w, momentum, block_m, interpret):
+    import jax.experimental.pallas as pl
+
+    rows, cols = p.shape
+    bm = min(block_m, rows)
+    tile = pl.BlockSpec((bm, cols), lambda i: (i, 0))
+    one = pl.BlockSpec((1, 1), lambda i: (0, 0))
+    return pl.pallas_call(
+        functools.partial(_sgd_accum_kernel, momentum=momentum),
+        grid=(pl.cdiv(rows, bm),),
+        in_specs=[tile, tile, tile, one, tile, one],
+        out_specs=[tile, tile, tile],
+        out_shape=[
+            jax.ShapeDtypeStruct(p.shape, p.dtype),
+            jax.ShapeDtypeStruct(m.shape, m.dtype),
+            jax.ShapeDtypeStruct(acc.shape, jnp.float32),
+        ],
+        interpret=interpret,
+    )(p, m, g, lr, acc, w)
+
+
+def _as2d(a):
+    return a.reshape(-1, a.shape[-1]) if a.ndim >= 2 else a.reshape(1, -1)
+
+
+def sgd_accum(p, m, g, lr_gate, *, momentum: float,
+              acc=None, weight=None, block_m: int = _BLOCK_M,
+              interpret: bool | None = None):
+    """Fused ``optax.sgd`` step — and optionally the FedAvg
+    contribution — in one streaming pass over the leaf.
+
+    ``m_new = g + momentum * m``; ``p_new = p + m_new * -lr_gate``;
+    with ``acc``/``weight`` given, also ``acc_new = acc + weight *
+    p_new`` (f32) so the optimizer step and the aggregation
+    contribution read the params once. ``lr_gate`` is the learning
+    rate pre-multiplied by the federation's update gate (1.0/0.0):
+    a gated-off leaf adds exactly ±0.0, i.e. keeps its params
+    bit-exactly while its momentum decays — the learner's ``where``
+    gate semantics. Returns ``(p_new, m_stored)`` or ``(p_new,
+    m_stored, acc_new)``; arbitrary-rank leaves are streamed as
+    ``[prod(shape[:-1]), shape[-1]]``.
+    """
+    shape = p.shape
+    p2, m2, g2 = _as2d(p), _as2d(m), _as2d(g)
+    lr2 = jnp.asarray(lr_gate, jnp.float32).reshape(1, 1)
+    itp = _interp(interpret)
+    if acc is None:
+        p_new, m_new = _sgd(p2, m2, g2, lr2, float(momentum),
+                            int(block_m), itp)
+        return p_new.reshape(shape), m_new.reshape(m.shape)
+    w2 = jnp.asarray(weight, jnp.float32).reshape(1, 1)
+    acc2 = _as2d(acc)
+    p_new, m_new, acc_new = _sgd_acc(p2, m2, g2, lr2, acc2, w2,
+                                     float(momentum), int(block_m), itp)
+    return (p_new.reshape(shape), m_new.reshape(m.shape),
+            acc_new.reshape(acc.shape))
+
+
+# ---------------------------------------------------------------------------
 # measured auto-select gate
 # ---------------------------------------------------------------------------
 
@@ -363,11 +534,15 @@ def _measure(kind: str, key: str, pallas_fn, xla_fn, args) -> str:
 def choose(kind: str, shapes: tuple, dtype) -> str:
     """Pick "pallas" or "xla" for one op instance.
 
-    ``kind``: "patches" (conv1 fwd+bwd GEMM) or "dense_bwd" (dense1
-    fused backward). ``shapes``: the per-node operand shapes as seen
-    at the call site. Measured decisions are cached per (kind, shapes,
-    dtype, nodes, backend); env/backend forcings are recorded too so
-    the bench table shows WHY a path ran.
+    ``kind``: "patches" (conv1 fwd+bwd GEMM), "dense_bwd" (dense1
+    fused backward), "conv2" (big-contraction conv as patches stream
+    vs grouped conv — ``shapes`` carries ``((M, K), (K, N), x_4d,
+    (kh, kw))`` so the measurement can rebuild the whole conv, patch
+    formation included), or "sgd_accum" (fused optimizer stream).
+    ``shapes``: the per-node operand shapes as seen at the call site.
+    Measured decisions are cached per (kind, shapes, dtype, nodes,
+    backend); env/backend forcings are recorded too so the bench
+    table shows WHY a path ran.
     """
     backend = jax.default_backend()
     dt = jnp.dtype(dtype).name
@@ -403,7 +578,12 @@ _MIN_GATE_FLOPS = 1e8  # per-instance GEMM flops worth measuring
 
 
 def _flops(kind, shapes) -> float:
-    (m, k), (_, n_out) = shapes
+    (m, k) = shapes[0]
+    if kind == "sgd_accum":
+        # memory-bound elementwise stream: score by elements moved,
+        # not GEMM flops (which would never clear the threshold)
+        return 8.0 * m * k
+    (_, n_out) = shapes[1]
     mult = 2.0 if kind == "dense_bwd" else 1.0  # bwd = two GEMMs
     return 2.0 * m * k * n_out * mult
 
@@ -437,6 +617,57 @@ def _measure_kind(kind: str, key: str, shapes, dtype, n) -> str:
             return _grad_through(jax.vmap(f))(x, w)
 
         return _measure(kind, key, pallas_fn, xla_fn, (x, w))
+    if kind == "conv2":
+        (_, kk), (_, f_out) = shapes[0], shapes[1]
+        b, hh, ww, cin = shapes[2]
+        kh, kw = shapes[3]
+        x = jnp.zeros((n, b, hh, ww, cin), dtype)
+        kern = jnp.zeros((n, kh, kw, cin, f_out), dtype)
+
+        def pallas_fn(x, kern):
+            def one(a, kr):
+                patches = jax.lax.conv_general_dilated_patches(
+                    a, (kh, kw), (1, 1), "SAME",
+                    dimension_numbers=("NHWC", "HWIO", "NHWC"),
+                )
+                wf = kr.transpose(2, 0, 1, 3).reshape(kk, f_out)
+                return conv2_matmul(patches.reshape(-1, kk), wf)
+
+            return _grad_through(jax.vmap(one))(x, kern)
+
+        def xla_fn(x, kern):
+            # the incumbent is the grouped-conv lowering, NOT an XLA
+            # patches matmul: patch materialization at K=800 is a 25x
+            # memory inflation (scripts/exp_im2col.py), so the fair
+            # fight is end-to-end conv vs end-to-end patches+kernel
+            def one(a, kr):
+                return jax.lax.conv_general_dilated(
+                    a, kr, (1, 1), "SAME",
+                    dimension_numbers=("NHWC", "HWIO", "NHWC"),
+                )
+
+            return _grad_through(jax.vmap(one))(x, kern)
+
+        return _measure(kind, key, pallas_fn, xla_fn, (x, kern))
+    if kind == "sgd_accum":
+        (m_rows, cols) = shapes[0]
+        p = jnp.zeros((n, m_rows, cols), dtype)
+        mom = jnp.zeros((n, m_rows, cols), dtype)
+        g = jnp.zeros((n, m_rows, cols), dtype)
+        lr = jnp.full((n,), 0.1, jnp.float32)
+
+        def pallas_fn(p, mom, g, lr):
+            f = lambda a, b, c, l: sgd_accum(a, b, c, l, momentum=0.9)
+            return jax.vmap(f)(p, mom, g, lr)
+
+        def xla_fn(p, mom, g, lr):
+            def f(a, b, c, l):
+                m_new = c + 0.9 * b
+                return a + m_new * -l, m_new.astype(b.dtype)
+
+            return jax.vmap(f)(p, mom, g, lr)
+
+        return _measure(kind, key, pallas_fn, xla_fn, (p, mom, g, lr))
     raise ValueError(f"unknown gate kind: {kind!r}")
 
 

@@ -567,6 +567,7 @@ def build_round_fn_cross_device(
     fns: StepFns,
     epochs: int = 1,
     exchange_dtype: Any | None = None,
+    fused_accumulate: bool = True,
 ) -> Callable:
     """The cross-device round (round 13): one compiled program runs a
     ``lax.scan`` over stacked cohorts, so an ``n_slots``-wide mesh
@@ -579,16 +580,39 @@ def build_round_fn_cross_device(
     broadcast across slots (init_federation same_init) — clients are
     transient, so every scan step trains its cohort from the
     round-start params, and the example-weighted FedAvg sums over all
-    ``C x n_slots`` sampled clients at once: per step the accumulator
-    gains ``dot(W_t, flat_t)`` where every row of ``W_t`` is that
-    cohort's slice of the globally normalized weights ``wn = w /
-    max(sum(w), 1e-9)``. Every slot therefore holds the same aggregate
-    afterwards — the cross-device analog of fully-connected DFL, and
-    deliberately the SAME dot shape ([n_slots, n_slots] @ [n_slots,
-    d]), operand order and f32 accumulation as the dense round's
-    ``leaf_mix``: at ``cohort_size == 1`` with every client sampled the
-    two programs are bit-identical (the parity gate in
-    tests/test_cross_device.py).
+    ``C x n_slots`` sampled clients at once against the globally
+    normalized weights ``wn = w / max(sum(w), 1e-9)``.
+
+    Two accumulation layouts produce that sum (round 17):
+
+    * ``fused_accumulate=True`` (default): every slot of the aggregate
+      is identical by construction, so the scan carries ONE flat f32
+      row per leaf (``[1, d]``) instead of the full ``[n_slots, d]``
+      accumulator — per step the cohort's weighted partial is folded
+      into the fit epilogue as ``acc += dot(W_t, flat_t)[0:1]``. The
+      slice sits behind an ``optimization_barrier`` so XLA cannot
+      rewrite slice-of-dot into a gemv with a different reduction
+      order: the dot INSTRUCTION is byte-identical to the unfused
+      reference's, which is what makes tolerance-0 parity hold at
+      every shape rather than by backend-kernel coincidence (a
+      ``[1, n] @ [n, d]`` row-dot is 1 ulp off the gemm row at some
+      CPU shapes). The carry (and its zeros init) is ``n_slots`` times
+      smaller, the read-modify-write of the accumulator per scan step
+      drops from ``2 * n_slots * d`` to ``2 * d`` floats, and the
+      round-end broadcast back to ``[n_slots, ...]`` happens once in
+      the keep/where epilogue.
+    * ``fused_accumulate=False``: the round-13 reference — per step
+      ``dot(W_t, flat_t)`` where every row of ``W_t`` is the cohort's
+      weight slice, accumulated at full ``[n_slots, d]``. Kept as the
+      parity anchor; the tolerance-0 gate in tests/test_cross_device.py
+      pins fused == unfused (params AND opt_state).
+
+    Both layouts run the SAME ``[n_slots, n_slots] @ [n_slots, d]``
+    dot with f32 accumulation — deliberately the dot shape of the
+    dense round's ``leaf_mix``, so at ``cohort_size == 1`` with every
+    client sampled the cross-device round stays bit-identical to the
+    dense stacked round (the round-13 parity gate) under either
+    layout.
 
     A sampled-but-dead client (``c_alive`` false — membership clock
     composition) trains nothing (the ``_train_and_select`` gate) and
@@ -600,7 +624,7 @@ def build_round_fn_cross_device(
 
     All shapes are fixed by ``(n_slots, C, shard_size)`` — resampling
     clients each round never recompiles (the crossdev_xla_recompiles
-    bench key pins this).
+    bench key pins this, for both layouts).
     """
 
     def round_fn(fed: FederatedState, cx, cy, cmask, c_sizes, c_alive):
@@ -616,9 +640,11 @@ def build_round_fn_cross_device(
         wn = w / denom
         got_any = jnp.sum(w) > 0
 
+        acc_rows = 1 if fused_accumulate else None
         acc0 = jax.tree.map(
             lambda p: jnp.zeros(
-                (p.shape[0], int(np.prod(p.shape[1:], dtype=np.int64))),
+                (acc_rows or p.shape[0],
+                 int(np.prod(p.shape[1:], dtype=np.int64))),
                 jnp.float32,
             ),
             params0,
@@ -636,15 +662,25 @@ def build_round_fn_cross_device(
                 fns, states_t, alive_t, trains, x_t, y_t, m_t, epochs
             )
 
+            # hoisted out of the leaf loop: one weight operand per step,
+            # not one broadcast+cast per leaf
+            w_t = jnp.broadcast_to(
+                wn_t[None, :], (n_slots, n_slots)
+            ).astype(mix_dt)
+
             def leaf_acc(a, p):
                 flat = p.reshape(p.shape[0], -1).astype(mix_dt)
-                w_t = jnp.broadcast_to(
-                    wn_t[None, :], (n_slots, n_slots)
-                )
-                return a + jax.lax.dot(
-                    w_t.astype(mix_dt), flat,
+                partial = jax.lax.dot(
+                    w_t, flat,
                     preferred_element_type=jnp.float32,
                 )
+                if fused_accumulate:
+                    # the barrier pins the gemm before the row slice —
+                    # without it XLA may turn slice-of-dot into a gemv
+                    # whose reduction order is 1 ulp off the gemm row,
+                    # breaking the tolerance-0 parity gates
+                    partial = jax.lax.optimization_barrier(partial)[0:1]
+                return a + partial
 
             acc = jax.tree.map(leaf_acc, acc, states_t.params)
             carry = (states_t.opt_state, states_t.rng, states_t.step,
@@ -660,7 +696,11 @@ def build_round_fn_cross_device(
         keep = jnp.logical_and(fed.alive, got_any)
 
         def leaf_out(a, p):
-            out = a.reshape(p.shape).astype(p.dtype)
+            if fused_accumulate:
+                row = a.reshape((1,) + p.shape[1:]).astype(p.dtype)
+                out = jnp.broadcast_to(row, p.shape)
+            else:
+                out = a.reshape(p.shape).astype(p.dtype)
             c = keep.reshape((n_slots,) + (1,) * (p.ndim - 1))
             return jnp.where(c, out, p)
 

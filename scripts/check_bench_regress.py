@@ -47,6 +47,7 @@ HEADLINE: dict[str, str] = {
     "cifar16_dirichlet_round_s": "lower",
     "cpu8_ring_dense_round_s": "lower",
     "crossdev_round_s_10k": "lower",
+    "crossdev_clients_per_s": "higher",
     "chaos_recovery_s": "lower",
     "chaos_final_accuracy": "higher",
     "aggd_round_s_24node_uncapped": "lower",

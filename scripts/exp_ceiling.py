@@ -297,6 +297,51 @@ def main() -> None:
               flops=2 * S * 3136 * 2048 * 2,
               bytes_moved=(S * (3136 + 2048) + n * 3136 * 2048) * 2,
               eff=tile_eff(2048, 3136))
+
+        # round 17: conv2 as patches + streamed GEMM vs the grouped
+        # rows above. End-to-end including patch formation — the 25x
+        # im2col inflation is the cost the gate must price in.
+        def _p2(a):
+            return jax.lax.conv_general_dilated_patches(
+                a, (5, 5), (1, 1), "SAME",
+                dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+        def c2_pallas_fwd(c):
+            x, w = c
+
+            def one(a, kr):
+                wf = kr.transpose(2, 0, 1, 3).reshape(800, 64)
+                return pallas_gemm.conv2_matmul(
+                    _p2(a).reshape(-1, 800), wf)
+
+            out = jax.vmap(one)(x, w).reshape(n, b, 14, 14, 64)
+            return out.mean(-1, keepdims=True) + x, w
+
+        probe("conv2 fwd pallas", c2_pallas_fwd, (x2, w2),
+              flops=S * 196 * 800 * 64 * 2,
+              bytes_moved=S * 196 * (32 + 800 + 64) * 2,
+              eff=tile_eff(800, 64))
+
+        def c2_pallas_wgrad(c):
+            x, w, cot = c
+
+            def f(ww):
+                def one(a, kr):
+                    wf = kr.transpose(2, 0, 1, 3).reshape(800, 64)
+                    return pallas_gemm.conv2_matmul(
+                        _p2(a).reshape(-1, 800), wf)
+
+                return jax.vmap(one)(x, ww).reshape(n, b, 14, 14, 64)
+
+            _, vjp = jax.vjp(f, w)
+            dw = vjp(cot)[0]
+            return x, dw + w, cot + jnp.broadcast_to(
+                dw.sum((1, 2, 3))[:, None, None, None, :], cot.shape)
+
+        probe("conv2 wgrad pallas", c2_pallas_wgrad, (x2, w2, cot2),
+              flops=S * 196 * 800 * 64 * 2,
+              bytes_moved=S * 196 * (64 + 32) * 2,
+              eff=tile_eff(800, 64))
     else:
         print("(pallas kernel probes skipped: backend is "
               f"{jax.default_backend()}, kernels target TPU Mosaic)",
@@ -329,6 +374,26 @@ def main() -> None:
     probe("sgd update stream", sgd_step, (params, grads, opt),
           flops=n * P * 4, bytes_moved=state_bytes, eff=1.0)
 
+    # round 17: the fused Pallas SGD stream at the same state shapes —
+    # one M-streamed pass over params/trace/grads vs optax's
+    # per-transform tree traversals. TPU-only like the GEMM probes.
+    if jax.default_backend() == "tpu":
+        from p2pfl_tpu.ops import pallas_gemm
+
+        def sgd_fused_pallas(c):
+            p, g, o = c
+
+            def f(pp, mm, gg):
+                return pallas_gemm.sgd_accum(pp, mm, gg, 0.05,
+                                             momentum=0.9)
+
+            p2, m2 = jax.vmap(f)(p, o[0].trace, g)
+            return p2, g, (o[0]._replace(trace=m2), o[1])
+
+        probe("sgd update fused pallas", sgd_fused_pallas,
+              (params, grads, opt),
+              flops=n * P * 4, bytes_moved=state_bytes, eff=1.0)
+
     # ---- FedAvg mixing einsum (bf16 stack) ---------------------------
     mix = jnp.abs(jax.random.normal(key, (n, n), jnp.float32))
     mixn = (mix / mix.sum(1, keepdims=True)).astype(dt)
@@ -348,7 +413,9 @@ def main() -> None:
     diagnostic = ("conv1 fwd packed4", "fedavg mix einsum",
                   "dense1 dgrad only", "dense1 wgrad only",
                   "conv1 fwd pallas", "conv1 wgrad pallas",
-                  "dense1 bwd pallas")
+                  "dense1 bwd pallas",
+                  "conv2 fwd pallas", "conv2 wgrad pallas",
+                  "sgd update fused pallas")
     per_step = [r for r in rows if r[0] not in diagnostic]
     meas = sum(r[1] for r in per_step)
     floor = sum(r[4] for r in per_step)

@@ -13,6 +13,17 @@ def kernel(o_ref, x):
     o_ref[...] = x * 2.0  # o_ref is a parameter — local store
 
 
+def multi_out_kernel(p_ref, m_ref, g_ref, p_out, m_out, acc_out):
+    # a fused Pallas kernel writes SEVERAL output refs, all
+    # parameters (round 17: sgd_accum-style kernels) — every store
+    # stays under the param-local exemption, including full-slice
+    # [:] stores and reads feeding them
+    m_new = g_ref[:] + 0.9 * m_ref[:]
+    p_out[:] = p_ref[:] + m_new * -0.1
+    m_out[:] = m_new.astype(m_out.dtype)
+    acc_out[:] = acc_out[:] + p_out[:]
+
+
 def run(xs):
     out, ys = lax.scan(body, 0.0, xs)
     jitted = jax.jit(kernel)

@@ -188,7 +188,10 @@ def test_cross_device_phase_dry_run_emits_key_plan():
     planned = set(parts[0]["crossdev_keys"])
     assert {"crossdev_round_s_10k", "crossdev_clients_per_s",
             "crossdev_cohort_scaling", "crossdev_rounds_to_target",
-            "crossdev_xla_recompiles"} <= planned
+            "crossdev_xla_recompiles",
+            # round 17: fused-accumulate A/B arm
+            "crossdev_fused_round_s", "crossdev_unfused_round_s",
+            "crossdev_fused_speedup"} <= planned
     assert planned <= set(bench.BENCH_KEYS)
 
 

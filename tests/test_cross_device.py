@@ -88,6 +88,93 @@ def test_cohort_scan_parity_with_dense_round_bit_for_bit():
             )
 
 
+def test_fused_vs_unfused_cohort_round_bit_for_bit():
+    """Round-17 gate, same contract as the dense-parity gate above: the
+    fused accumulate (single [1, d] carry row per leaf, weighted reduce
+    in the fit epilogue) must equal the round-13 unfused reference
+    ([n_slots, d] accumulator, full [n_slots, n_slots] dot) with
+    tolerance 0 on every param AND optimizer-state leaf, over multiple
+    rounds, with heterogeneous shard sizes and a dead cohort member in
+    the mix."""
+    from p2pfl_tpu.parallel.federated import (
+        build_round_fn_cross_device,
+        init_federation,
+    )
+
+    n, s, c = 4, 8, 3
+    rng = np.random.default_rng(17)
+    x = rng.normal(size=(c, n, s, 28, 28, 1)).astype(np.float32)
+    y = rng.integers(0, 10, size=(c, n, s)).astype(np.int32)
+    mask = np.ones((c, n, s), bool)
+    # heterogeneous example weights + one dead client: the weighted
+    # normalization and the keep/where epilogue are both in play
+    sizes = rng.integers(1, s + 1, size=(c, n)).astype(np.int32)
+    alive = np.ones((c, n), bool)
+    alive[2, 1] = False
+
+    fns = _mk_fns()
+    fused = jax.jit(build_round_fn_cross_device(
+        fns, epochs=1, fused_accumulate=True))
+    unfused = jax.jit(build_round_fn_cross_device(
+        fns, epochs=1, fused_accumulate=False))
+    fed_f = init_federation(fns, jnp.asarray(x[0, 0, :1]), n, seed=5)
+    fed_u = init_federation(fns, jnp.asarray(x[0, 0, :1]), n, seed=5)
+
+    for r in range(3):
+        fed_f, _ = fused(fed_f, x, y, mask, sizes, alive)
+        fed_u, _ = unfused(fed_u, x, y, mask, sizes, alive)
+        for a, b in zip(jax.tree.leaves(fed_f.states.params),
+                        jax.tree.leaves(fed_u.states.params)):
+            assert np.array_equal(np.asarray(a), np.asarray(b)), (
+                f"param leaf diverged at round {r}"
+            )
+        for a, b in zip(jax.tree.leaves(fed_f.states.opt_state),
+                        jax.tree.leaves(fed_u.states.opt_state)):
+            assert np.array_equal(np.asarray(a), np.asarray(b)), (
+                f"opt leaf diverged at round {r}"
+            )
+
+
+def test_fused_cohort_round_zero_recompiles_after_warmup():
+    """Resampling clients every round never recompiles the fused
+    program: after one warm-up invocation, rounds with freshly drawn
+    cohorts (different data, sizes, liveness — same shapes) must hit
+    the jit cache, mirroring the crossdev_xla_recompiles bench pin."""
+    from p2pfl_tpu.obs import trace as obs_trace
+    from p2pfl_tpu.parallel.federated import (
+        build_round_fn_cross_device,
+        init_federation,
+    )
+
+    assert obs_trace.install_xla_listener() is True
+    n, s, c = 4, 8, 2
+    rng = np.random.default_rng(23)
+
+    def draw():
+        x = rng.normal(size=(c, n, s, 28, 28, 1)).astype(np.float32)
+        y = rng.integers(0, 10, size=(c, n, s)).astype(np.int32)
+        mask = np.ones((c, n, s), bool)
+        sizes = rng.integers(1, s + 1, size=(c, n)).astype(np.int32)
+        alive = rng.random((c, n)) > 0.2
+        alive[0, 0] = True  # never an all-dead round
+        return x, y, mask, sizes, alive
+
+    fns = _mk_fns()
+    fused = jax.jit(build_round_fn_cross_device(
+        fns, epochs=1, fused_accumulate=True))
+    x, y, mask, sizes, alive = draw()
+    fed = init_federation(fns, jnp.asarray(x[0, 0, :1]), n, seed=2)
+    fed, _ = fused(fed, x, y, mask, sizes, alive)  # warm-up compile
+    jax.block_until_ready(fed)
+
+    obs_trace.reset_xla_counters()
+    for _ in range(3):
+        fed, _ = fused(fed, *draw())
+    jax.block_until_ready(fed)
+    assert obs_trace.xla_recompiles() == 0
+    obs_trace.reset_xla_counters()
+
+
 def test_cohort_scan_dead_client_zero_weight():
     """A dead cohort member neither trains nor contributes weight: the
     round with the member dead must equal the round where that member's

@@ -123,6 +123,129 @@ def test_rejects_non_2d():
         pallas_gemm.dense_matmul(jnp.zeros((2, 3)), jnp.zeros((1, 3, 4)))
 
 
+# ---- conv2 stream (round 17: big-contraction conv class) -----------------
+
+
+@pytest.mark.parametrize("m", _MS)
+def test_conv2_matmul_forward_parity(m):
+    # conv2 geometry: K=800 (ragged vs the 128 lane), N=64 — exact
+    # where it matters, M scaled down like the other kernels
+    x, w = _mk((m, 800), 20), _mk((800, 64), 21)
+    got = pallas_gemm.conv2_matmul(x, w, block_m=_BLOCK, interpret=True)
+    want = (x.astype(jnp.float32) @ w.astype(jnp.float32)).astype(x.dtype)
+    _close(got, want, 2e-2)
+
+
+@pytest.mark.parametrize("m", [64, 129])  # aligned + ragged edge
+def test_conv2_matmul_grad_parity(m):
+    """fwd + dgrad (XLA inside the VJP) + wgrad (Pallas stream) vs
+    pure-XLA autodiff. The ragged m exercises the wgrad masking — an
+    unmasked garbage row in the last tile would NaN/garble the whole
+    [K, N] accumulator, not one row (cross-row reduction)."""
+    x, w = _mk((m, 800), 22), _mk((800, 64), 23)
+
+    def loss_pallas(x, w):
+        y = pallas_gemm.conv2_matmul(x, w, block_m=_BLOCK, interpret=True)
+        return jnp.sum(y.astype(jnp.float32) ** 2)
+
+    def loss_xla(x, w):
+        return jnp.sum((x @ w).astype(jnp.float32) ** 2)
+
+    (gx, gw) = jax.grad(loss_pallas, argnums=(0, 1))(x, w)
+    (hx, hw) = jax.grad(loss_xla, argnums=(0, 1))(x, w)
+    _close(gx, hx, 0.15)  # bf16 squared-loss cotangents
+    _close(gw, hw, 0.15)
+    assert np.isfinite(np.asarray(gw, np.float32)).all()
+
+
+def test_conv2_matmul_rejects_non_2d():
+    with pytest.raises(ValueError, match="2-D"):
+        pallas_gemm.conv2_matmul(jnp.zeros((2, 3, 4)), jnp.zeros((4, 5)))
+
+
+# ---- sgd_accum stream (round 17: fused optimizer step) --------------------
+
+# interpret mode lowers through XLA:CPU, whose fp-contraction fuses
+# mul+add chains into FMAs (no intermediate f32 rounding) — the kernel
+# can land 1 ulp from the two-step optax expression, so these parity
+# checks use a few-ulp f32 tolerance rather than bit equality. The
+# bit-exact contracts that matter to the federation (gate=0 keeps
+# params, gate folding) ARE asserted exactly below.
+_SGD_TOL = 1e-5
+
+
+def _optax_sgd_ref(p, m, g, lr, momentum=0.9):
+    # optax.sgd term by term: trace-dtype decay multiply, f32 add,
+    # uncast update scaled by -lr, stored trace cast back
+    m_new = g + momentum * m
+    return ((p + m_new * -lr).astype(p.dtype),
+            m_new.astype(m.dtype))
+
+
+@pytest.mark.parametrize("trace_dtype", [jnp.float32, jnp.bfloat16])
+@pytest.mark.parametrize("rows", [64, 7])  # aligned + ragged edge
+def test_sgd_accum_update_parity(rows, trace_dtype):
+    p = _mk((rows, 130), 24, jnp.float32)
+    m = _mk((rows, 130), 25, trace_dtype)
+    g = _mk((rows, 130), 26, jnp.float32)
+    lr = jnp.float32(0.1)
+    got_p, got_m = pallas_gemm.sgd_accum(p, m, g, lr, momentum=0.9,
+                                         block_m=16, interpret=True)
+    want_p, want_m = _optax_sgd_ref(p, m, g, lr)
+    _close(got_p, want_p, _SGD_TOL)
+    tol = 1e-2 if trace_dtype == jnp.bfloat16 else _SGD_TOL
+    _close(got_m, want_m, tol)
+    assert got_m.dtype == trace_dtype  # stored in the accumulator dtype
+
+
+def test_sgd_accum_fused_accumulate_parity():
+    """The accumulate arm: acc_new = acc + weight * p_new (f32), fused
+    into the same stream as the optimizer step."""
+    p = _mk((40, 96), 27, jnp.float32)
+    m = _mk((40, 96), 28, jnp.bfloat16)
+    g = _mk((40, 96), 29, jnp.float32)
+    acc = _mk((40, 96), 30, jnp.float32)
+    lr, w = jnp.float32(0.05), jnp.float32(0.25)
+    got_p, got_m, got_a = pallas_gemm.sgd_accum(
+        p, m, g, lr, momentum=0.9, acc=acc, weight=w,
+        block_m=16, interpret=True)
+    want_p, _ = _optax_sgd_ref(p, m, g, lr)
+    _close(got_p, want_p, _SGD_TOL)
+    assert got_a.dtype == jnp.float32
+    _close(got_a, acc + w * want_p, _SGD_TOL)
+
+
+def test_sgd_accum_gate_zero_keeps_params_bit_exact():
+    """lr_gate = lr * 0.0: the federation's where-gate folded into the
+    kernel — a gated-off node adds exactly +/-0.0 (params bit-kept)
+    while its momentum still decays. This is the contract the learner
+    wiring relies on, so it is asserted EXACTLY, not with tolerance."""
+    p = _mk((33, 64), 31, jnp.float32)
+    m = _mk((33, 64), 32, jnp.bfloat16)
+    g = _mk((33, 64), 33, jnp.float32)
+    got_p, got_m = pallas_gemm.sgd_accum(p, m, g, jnp.float32(0.0),
+                                         momentum=0.9, block_m=16,
+                                         interpret=True)
+    assert np.array_equal(np.asarray(got_p), np.asarray(p))
+    _close(got_m, (g + 0.9 * m).astype(m.dtype), 1e-2)
+
+
+@pytest.mark.parametrize("shape", [(62,), (5, 5, 4, 8)])
+def test_sgd_accum_reshapes_arbitrary_rank_leaves(shape):
+    """Bias vectors and conv kernels stream as [prod(:-1), last] and
+    come back in their own shape."""
+    p = _mk(shape, 34, jnp.float32)
+    m = _mk(shape, 35, jnp.float32)
+    g = _mk(shape, 36, jnp.float32)
+    lr = jnp.float32(0.1)
+    got_p, got_m = pallas_gemm.sgd_accum(p, m, g, lr, momentum=0.9,
+                                         block_m=8, interpret=True)
+    assert got_p.shape == shape and got_m.shape == shape
+    want_p, want_m = _optax_sgd_ref(p, m, g, lr)
+    _close(got_p, want_p, _SGD_TOL)
+    _close(got_m, want_m, _SGD_TOL)
+
+
 # ---- gate behavior -------------------------------------------------------
 
 
@@ -214,3 +337,47 @@ def test_femnist_cnn_trains_through_forced_pallas(monkeypatch):
     flat_x = jax.tree.leaves(g_xla)
     for a, b in zip(flat_p, flat_x):
         _close(a, b, 5e-2)
+
+
+def test_learner_fused_sgd_path_matches_optax(monkeypatch):
+    """The learner's fused-SGD wiring with the kernels FORCED on
+    (CPU → interpret mode): trains close to the exact tx.update path
+    over multiple steps, hits the sgd_accum gate kind, and preserves
+    the federation gate contracts bit-exactly (gate=0 freezes params;
+    gate=1 equals ungated — lr * 1.0 is exact)."""
+    from p2pfl_tpu.learning.learner import make_step_fns
+    from p2pfl_tpu.models.cnn import SmallCNN
+
+    model = SmallCNN(channels=(4, 8), kernel=5, hidden=32, num_classes=10)
+    x = _mk((32, 28, 28, 1), 40, jnp.float32)
+    y = jnp.asarray(np.arange(32) % 10)
+    mask = jnp.ones(32, bool)
+
+    def run(st0, fns, **kw):
+        train = jax.jit(fns.train_epochs, static_argnames=("epochs",))
+        return train(st0, x, y, mask, epochs=2, **kw)
+
+    fns = make_step_fns(model, momentum_dtype="bf16", batch_size=8)
+    st0 = fns.init(jax.random.PRNGKey(0), x[:1])
+    st_ref, _ = run(st0, fns)  # gate forces xla on CPU → exact optax
+
+    monkeypatch.setenv(pallas_gemm.ENV_KNOB, "on")
+    pallas_gemm.clear_cache()
+    fns_f = make_step_fns(model, momentum_dtype="bf16", batch_size=8)
+    st_fused, _ = run(st0, fns_f)
+    assert any(rec["kind"] == "sgd_accum" and rec["impl"] == "pallas"
+               for rec in pallas_gemm.decisions().values())
+    # every other kernel is forced on too, so the comparison absorbs
+    # bf16-GEMM noise compounded over 8 steps — loose but real
+    for a, b in zip(jax.tree.leaves(st_ref.params),
+                    jax.tree.leaves(st_fused.params)):
+        _close(a, b, 1e-1)
+
+    st_g0, _ = run(st0, fns_f, gate=jnp.float32(0.0))
+    for a, b in zip(jax.tree.leaves(st0.params),
+                    jax.tree.leaves(st_g0.params)):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+    st_g1, _ = run(st0, fns_f, gate=jnp.float32(1.0))
+    for a, b in zip(jax.tree.leaves(st_fused.params),
+                    jax.tree.leaves(st_g1.params)):
+        assert np.array_equal(np.asarray(a), np.asarray(b))

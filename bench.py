@@ -1084,6 +1084,9 @@ BENCH_KEYS = (
     "obs_dry", "obs_keys", "obs_round_s_untraced", "obs_round_s_traced",
     "obs_overhead_pct", "obs_xla_recompiles", "obs_trace_file_bytes",
     *("obs_attr_" + s.replace(".", "_") + "_s" for s in _OBS_ATTR_SPANS),
+    # obs critical path (round 18: cross-node causal tracing)
+    "critpath_wire_s_24node", "critpath_wait_s_24node",
+    "critpath_sum_err_pct_24node",
     # comm (round 10: overlap + wire-dtype A/Bs)
     "comm_dry", "comm_keys", *_COMM_KEYS,
     # elastic (round 11: churn + straggler survival)
@@ -1355,12 +1358,20 @@ def _phase_obs() -> None:
     traced run's span-family attribution seconds, the post-warm-up
     recompile counter, and the exported trace file size.
 
+    Round 18 adds arm (c): a traced run of the §7b 24-node uncapped
+    scenario fed through ``obs.critpath`` — per-node wire/wait seconds
+    plus the worst components-vs-wall sum error (the 10% acceptance
+    gate on the attribution itself).
+
     ``P2PFL_OBS_DRY=1`` emits the key plan without touching the
     accelerator — the orchestration test's smoke hook."""
     obs_keys = ["obs_round_s_untraced", "obs_round_s_traced",
                 "obs_overhead_pct", "obs_xla_recompiles",
                 "obs_trace_file_bytes"] + [
-        "obs_attr_" + s.replace(".", "_") + "_s" for s in _OBS_ATTR_SPANS]
+        "obs_attr_" + s.replace(".", "_") + "_s"
+        for s in _OBS_ATTR_SPANS] + [
+        "critpath_wire_s_24node", "critpath_wait_s_24node",
+        "critpath_sum_err_pct_24node"]
     if os.environ.get("P2PFL_OBS_DRY") == "1":
         _part({"obs_dry": True, "obs_keys": obs_keys})
         return
@@ -1444,6 +1455,53 @@ def _phase_obs() -> None:
             part["obs_trace_file_bytes"] = sum(
                 p.stat().st_size for p in traces)
         _part(part)
+
+    # ---- (c) critical-path validation on §7b's 24-node uncapped run
+    # (round 18): one traced simulation at the payload-bound scale the
+    # staged-overlap/sidecar A/Bs target, then the offline analyzer
+    # over its merged trace. Emits the mean per-node wire/wait seconds
+    # of the last round plus the worst components-vs-wall sum error —
+    # the "within 10%" acceptance observable.
+    from p2pfl_tpu.obs import critpath as _critpath
+
+    def cfg24(log_dir):
+        return ScenarioConfig(
+            name="cp24", n_nodes=24, topology="fully",
+            data=DataConfig(dataset="mnist", samples_per_node=60),
+            training=TrainingConfig(rounds=3, epochs_per_round=1,
+                                    learning_rate=0.05),
+            protocol=ProtocolConfig(heartbeat_period_s=0.5,
+                                    aggregation_timeout_s=60.0,
+                                    vote_timeout_s=10.0, train_set_size=24,
+                                    gossip_fanout=12),
+            log_dir=log_dir,
+        )
+
+    with tempfile.TemporaryDirectory() as td24:
+        os.environ["P2PFL_TRACE"] = "1"
+        try:
+            get_tracer().reset()
+            run_simulation(cfg24(td24), timeout=280)
+        finally:
+            os.environ["P2PFL_TRACE"] = "0"
+        result = _critpath.analyze(_critpath.load_merged([td24]))
+        rounds = {rn: rec for rn, rec in result["rounds"].items()
+                  if rec["nodes"]}
+        cp_part: dict = {}
+        if rounds:
+            comps = list(rounds[max(rounds)]["nodes"].values())
+            cp_part["critpath_wire_s_24node"] = round(
+                sum(c["wire_s"] for c in comps) / len(comps), 4)
+            cp_part["critpath_wait_s_24node"] = round(
+                sum(c["wait_s"] for c in comps) / len(comps), 4)
+            errs = [
+                abs(c["fit_s"] + c["wire_s"] + c["wait_s"] + c["agg_s"]
+                    + c["other_s"] - c["round_s"]) / c["round_s"]
+                for c in comps if c["round_s"]]
+            if errs:
+                cp_part["critpath_sum_err_pct_24node"] = round(
+                    100.0 * max(errs), 2)
+        _part(cp_part)
 
 
 def _phase_obs_health() -> None:
@@ -2469,7 +2527,7 @@ def main() -> None:
         ("socket24", "_phase_socket24", 45),
         ("comm", "_phase_comm", 150),
         ("socket_mp", "_phase_socket_mp", 150),
-        ("obs", "_phase_obs", 90),
+        ("obs", "_phase_obs", 150),
         ("obs_health", "_phase_obs_health", 120),
         ("robust", "_phase_robust", 150),
         ("elastic", "_phase_elastic", 150),

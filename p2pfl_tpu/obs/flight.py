@@ -41,6 +41,8 @@ import time
 from collections import deque
 from typing import Any
 
+from p2pfl_tpu.obs import trace as _trace
+
 ENV_VAR = "P2PFL_FLIGHT"
 _RING_MAX = 1 << 12  # control-plane events are rare; 4096 spans hours
 
@@ -87,6 +89,12 @@ class FlightRecorder:
         atomic deque.append."""
         if not self.enabled:
             return
+        # Stamp the active trace identity so a postmortem's control
+        # events can be joined against the span timeline. One attribute
+        # read when tracing is off — the recorder stays always-on cheap.
+        tr = _trace.get_tracer()
+        if tr.enabled and "trace" not in fields:
+            fields["trace"] = tr.trace_id
         self._events.append((time.time(), kind, fields))
 
     # -- reading --------------------------------------------------------

@@ -42,6 +42,7 @@ visible in every bench record instead of needing a hand profile.
 
 from __future__ import annotations
 
+import itertools
 import json
 import os
 import pathlib
@@ -123,6 +124,12 @@ class Tracer:
         # merging (traceview shifts by wall_t0 deltas)
         self.wall_t0 = time.time()
         self.perf_t0 = time.perf_counter()
+        # per-process trace identity: span ids minted by next_span_id()
+        # are prefixed with this, so they stay unique in a merged
+        # multi-process trace and a wire-propagated parent id resolves
+        # without pid coordination
+        self.trace_id = os.urandom(4).hex()
+        self._span_ids = itertools.count(1)
 
     def configure(self, enabled: bool | None = None,
                   export_dir: str | pathlib.Path | None = None,
@@ -153,6 +160,14 @@ class Tracer:
         if not self.enabled:
             return NULL_SPAN
         return _Span(self, name, lane, args)
+
+    def next_span_id(self) -> str:
+        """Mint a globally-unique span id (``<trace_id>.<n>``) for a
+        span whose identity must cross the wire (the ``tc`` header).
+        Only meaningful while enabled — callers gate on ``enabled``
+        first, so the disabled path never reaches the allocation.
+        ``itertools.count`` is GIL-atomic, no lock."""
+        return f"{self.trace_id}.{next(self._span_ids)}"
 
     def count(self, key: str, n: float = 1) -> None:
         """Accumulate a counter (message/byte totals, compile seconds)."""
@@ -227,6 +242,19 @@ class Tracer:
                   "tid": lanes[lane], "ts": ts, "dur": dur * 1e6}
             if args:
                 ev["args"] = args
+                # cross-process causal edges render as Perfetto flow
+                # arrows: a span that minted a wire-propagated id is a
+                # flow source; one recorded with a parent id is a sink
+                sid = args.get("sid")
+                if sid is not None:
+                    out.append({"name": "tc", "cat": "tc", "ph": "s",
+                                "id": sid, "pid": pid,
+                                "tid": lanes[lane], "ts": ts})
+                parent = args.get("parent")
+                if parent is not None:
+                    out.append({"name": "tc", "cat": "tc", "ph": "f",
+                                "bp": "e", "id": parent, "pid": pid,
+                                "tid": lanes[lane], "ts": ts})
             out.append(ev)
         for lane, tid in lanes.items():
             events.append({

@@ -45,7 +45,19 @@ def merge(paths: list[pathlib.Path | str]) -> dict:
     """
     docs = []
     for p in paths:
-        doc = json.loads(pathlib.Path(p).read_text())
+        # A crashed or still-writing process leaves a zero-byte or torn
+        # file; skip it with a warning (like status tail_jsonl) so one
+        # bad exporter cannot take down the whole postmortem merge.
+        try:
+            doc = json.loads(pathlib.Path(p).read_text())
+        except (OSError, ValueError) as exc:
+            print(f"traceview: skipping unreadable trace file {p}: {exc}",
+                  file=sys.stderr)
+            continue
+        if not isinstance(doc, dict):
+            print(f"traceview: skipping non-object trace file {p}",
+                  file=sys.stderr)
+            continue
         meta = doc.get("metadata", {})
         docs.append((float(meta.get("wall_t0", 0.0)), doc))
     if not docs:
@@ -93,6 +105,9 @@ def main(argv: list[str] | None = None) -> int:
         print(f"no *.trace.json files under {args.inputs}", file=sys.stderr)
         return 1
     merged = merge(paths)
+    if merged["metadata"]["files"] == 0:
+        print(f"no readable trace files under {args.inputs}", file=sys.stderr)
+        return 1
     out = pathlib.Path(args.output)
     out.write_text(json.dumps(merged))
     n_spans = sum(1 for e in merged["traceEvents"] if e.get("ph") == "X")

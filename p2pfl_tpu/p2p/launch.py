@@ -140,6 +140,24 @@ def _aggd_status(client: SidecarClient | None) -> dict:
     }
 
 
+def _critpath_status(node) -> dict:
+    """Flatten the node's last per-round critical-path snapshot into
+    ``critpath_*`` status gauges — the monitor's WAIT% column and the
+    webapp's breakdown pane read these. Empty before round 1 closes."""
+    cp = node.critpath_last
+    if not cp:
+        return {}
+    return {
+        "critpath_round": cp["round"],
+        "critpath_round_s": cp["round_s"],
+        "critpath_fit_s": cp["fit_s"],
+        "critpath_wire_s": cp["wire_s"],
+        "critpath_wait_s": cp["wait_s"],
+        "critpath_agg_s": cp["agg_s"],
+        "critpath_other_s": cp["other_s"],
+    }
+
+
 def _free_ports(n: int) -> list[int]:
     socks, ports = [], []
     for _ in range(n):
@@ -260,6 +278,7 @@ async def _run_node(cfg: ScenarioConfig, idx: int, ports: list[int],
                      "peer_bytes_in": dict(node.peer_bytes_in),
                      "peer_bytes_out": dict(node.peer_bytes_out),
                      "recompiles": obs_trace.xla_recompiles(),
+                     **_critpath_status(node),
                      **_aggd_status(sidecar)},
                 )
                 await asyncio.sleep(cfg.protocol.heartbeat_period_s)
@@ -527,6 +546,7 @@ async def _simulate(cfg: ScenarioConfig, timeout: float = 600) -> dict:
                      "peer_bytes_in": dict(nd.peer_bytes_in),
                      "peer_bytes_out": dict(nd.peer_bytes_out),
                      "recompiles": obs_trace.xla_recompiles(),
+                     **_critpath_status(nd),
                      **_aggd_status(sidecar)},
                 )
 

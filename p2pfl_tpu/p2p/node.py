@@ -50,7 +50,7 @@ from p2pfl_tpu.core.serialize import (
 from p2pfl_tpu.federation.events import Events
 from p2pfl_tpu.federation.membership import Membership
 from p2pfl_tpu.obs import flight
-from p2pfl_tpu.obs.trace import get_tracer
+from p2pfl_tpu.obs.trace import NULL_SPAN, get_tracer
 from p2pfl_tpu.p2p.protocol import (
     GOSSIPED,
     PERIODIC_FLOODS,
@@ -239,6 +239,19 @@ class P2PNode:
         # per-round wall clocks (appended by _learning_loop) — the p95
         # the status publisher reports comes from here
         self.round_wall_s: list[float] = []
+        # per-round critical-path accumulators (round 18): plain-float
+        # adds like bytes_in — always-on except _cp_wire_s, which needs
+        # the sender's tc stamp and therefore only accrues while
+        # tracing is on. _learning_loop snapshots them into
+        # ``critpath_last`` at every round close (the status publisher
+        # flattens that into critpath_* gauges) and zeroes them.
+        self._cp_fit_s = 0.0
+        self._cp_wait_s = 0.0
+        self._cp_wire_s = 0.0
+        self._cp_agg_mark = 0.0
+        #: last completed round's fit/wire/wait/aggregate/other split
+        #: (None until a round finishes)
+        self.critpath_last: dict[str, float] | None = None
         # elasticity profile (round 11): async aggregation knobs feed
         # the session, heartbeat probe/backoff knobs feed membership,
         # and the per-node compute class (fit_slowdown / local_epochs)
@@ -1042,6 +1055,34 @@ class P2PNode:
                 self.leader_history.append(self.leader)
 
     async def _on_params(self, peer: PeerState, msg: Message) -> None:
+        """Traced entry: a tc-stamped frame (sender was tracing) is
+        handled under a ``p2p.rx`` span parented to the sender's tx
+        span — the cross-process edge — and its send→receive wall
+        delta accrues into the round's wire seconds (skew-clamped; the
+        critpath analyzer does the proper pairwise skew correction
+        offline). Untraced (or legacy) frames skip straight through."""
+        tr = self._tracer
+        if tr.enabled and msg.tc is not None:
+            rx_ns = time.time_ns()
+            lat_s = (rx_ns - int(msg.tc[2])) / 1e9
+            if 0.0 < lat_s < 60.0:
+                self._cp_wire_s += lat_s
+            with tr.span(
+                "p2p.rx", lane=self._lane,
+                args={"parent": msg.tc[1], "trace": msg.tc[0],
+                      "tx_ns": int(msg.tc[2]), "rx_ns": rx_ns,
+                      "from": msg.sender,
+                      "round": int(msg.body.get("round", -1))},
+            ):
+                return await self._on_params_inner(peer, msg)
+        return await self._on_params_inner(peer, msg)
+
+    async def _on_params_inner(self, peer: PeerState,
+                               msg: Message) -> None:
+        # sender's tx span id: threads into session.add_model spans so
+        # the ingest parents to the send even across a buffered replay
+        cp = (msg.tc[1]
+              if self._tracer.enabled and msg.tc is not None else None)
         if msg.body.get("init"):
             if not self.initialized:
                 payload = decode_parameters(msg.payload)
@@ -1103,7 +1144,7 @@ class P2PNode:
                         covered = self.session.add_slot(
                             msg._slot, msg._slot_len, contribs,
                             int(msg.body.get("w", 1)),
-                            staleness=staleness,
+                            staleness=staleness, parent=cp,
                         )
                         msg._slot = None  # session owns it now
                         if self._tracer.enabled:
@@ -1132,7 +1173,7 @@ class P2PNode:
                         covered = self.session.add_blob(
                             msg.payload, contribs,
                             int(msg.body.get("w", 1)),
-                            staleness=staleness,
+                            staleness=staleness, parent=cp,
                         )
                         if self._tracer.enabled:
                             self._tracer.count("stale_params_folded")
@@ -1152,7 +1193,7 @@ class P2PNode:
                 if contribs and not (ts and contribs >= ts):
                     covered = self.session.add_model(
                         payload.params, payload.contributors,
-                        payload.weight, staleness=staleness,
+                        payload.weight, staleness=staleness, parent=cp,
                     )
                     if self._tracer.enabled:
                         self._tracer.count("stale_params_folded")
@@ -1188,13 +1229,14 @@ class P2PNode:
                 msg._slot = None
                 payload = decode_parameters(blob)
                 covered = self.session.add_model(
-                    payload.params, payload.contributors, payload.weight
+                    payload.params, payload.contributors, payload.weight,
+                    parent=cp,
                 )
             else:
                 covered = self.session.add_slot(
                     msg._slot, msg._slot_len,
                     tuple(int(c) for c in msg.body.get("c") or ()),
-                    int(msg.body.get("w", 1)),
+                    int(msg.body.get("w", 1)), parent=cp,
                 )
                 msg._slot = None  # session owns it now
         elif (self.sidecar is not None and not self.session.waiting
@@ -1205,13 +1247,14 @@ class P2PNode:
             covered = self.session.add_blob(
                 msg.payload,
                 tuple(int(c) for c in msg.body.get("c") or ()),
-                int(msg.body.get("w", 1)),
+                int(msg.body.get("w", 1)), parent=cp,
             )
         else:
             self.loop_payload_touch_bytes += len(msg.payload)
             payload = decode_parameters(msg.payload)
             covered = self.session.add_model(
-                payload.params, payload.contributors, payload.weight
+                payload.params, payload.contributors, payload.weight,
+                parent=cp,
             )
         if covered:
             await self.broadcast(
@@ -1310,7 +1353,12 @@ class P2PNode:
         if self._verifier is None:
             return True
         tr = self._tracer
-        with tr.span("p2p.verify", lane=self._lane):
+        # a tc-stamped frame parents its verify span to the sender's
+        # tx span (args built only on the traced path)
+        args = None
+        if tr.enabled and msg.tc is not None:
+            args = {"parent": msg.tc[1], "from": msg.sender}
+        with tr.span("p2p.verify", lane=self._lane, args=args):
             ok = self._verifier.verify(
                 msg.cert, msg.sig, msg.signing_bytes(), msg.sender
             )
@@ -1505,20 +1553,38 @@ class P2PNode:
                     # proxies relay it and need at-most-once dedup
                     msg_id=secrets.token_hex(8))
         )
-        congested = [
-            p for p in peers
-            if self.shaper is not None or not self._try_fast_write(p, msg)
-        ]
-        if not congested:
-            return
+        # causal trace context (round 18): stamp the header's tc
+        # BEFORE the first encode (the framed-header memo is built
+        # once for the whole target list) and time the send under a
+        # tx span whose id rides the wire — rx-side spans parent to
+        # it, turning the merged trace into a cross-process graph.
+        # Untraced path: msg.tc stays None and the header bytes are
+        # identical to the pre-tc format (pinned by test).
+        tr = self._tracer
+        tx_span = NULL_SPAN
+        if tr.enabled:
+            sid = tr.next_span_id()
+            msg.tc = (tr.trace_id, sid, time.time_ns())
+            tx_span = tr.span(
+                "p2p.tx", lane=self._lane,
+                args={"sid": sid, "round": int(body["round"]),
+                      "n_peers": len(peers), "bytes": len(blob)})
+        with tx_span:
+            congested = [
+                p for p in peers
+                if self.shaper is not None
+                or not self._try_fast_write(p, msg)
+            ]
+            if not congested:
+                return
 
-        async def ship(peer: PeerState) -> None:
-            try:
-                await self._write(peer, msg)
-            except (ConnectionError, RuntimeError):
-                self._drop_conn(peer)
+            async def ship(peer: PeerState) -> None:
+                try:
+                    await self._write(peer, msg)
+                except (ConnectionError, RuntimeError):
+                    self._drop_conn(peer)
 
-        await asyncio.gather(*(ship(p) for p in congested))
+            await asyncio.gather(*(ship(p) for p in congested))
 
     # ------------------------------------------------------------------
     # control plane loops
@@ -1778,10 +1844,15 @@ class P2PNode:
                 if self.round >= self.total_rounds:
                     break
             t0 = time.monotonic()
+            round_no = self.round
+            self._cp_fit_s = self._cp_wait_s = self._cp_wire_s = 0.0
+            self._cp_agg_mark = self.session.agg_wall_s
             with self._tracer.span("node.round", lane=self._lane,
                                    args={"round": self.round}):
                 await self._train_round()
-            self.round_wall_s.append(time.monotonic() - t0)
+            wall = time.monotonic() - t0
+            self.round_wall_s.append(wall)
+            self._cp_snapshot(round_no, wall)
             self._maybe_checkpoint()
         self.learn_t1 = time.monotonic()
         # final evaluation, shared with the federation (the metrics
@@ -1876,6 +1947,34 @@ class P2PNode:
             await asyncio.sleep(
                 (time.monotonic() - t0) * (self.fit_slowdown - 1.0)
             )
+        # slowdown sleep included: the critical path cares how long
+        # this node's update took to exist, not why
+        self._cp_fit_s += time.monotonic() - t0
+
+    def _cp_snapshot(self, round_no: int, wall: float) -> None:
+        """Fold the round's accumulators into ``critpath_last`` — the
+        per-node fit/wire/wait/aggregate/other split the status
+        publisher flattens into critpath_* gauges (monitor WAIT%
+        column, webapp breakdown pane).
+
+        Wire seconds accrue per received frame and overlap the quorum
+        wait (arrivals land while this node sleeps in the wait loops),
+        so wire is carved OUT of wait: of the time spent waiting, wire
+        is the part the bytes were actually in flight/queued, wait is
+        the part the peers simply hadn't finished. ``other`` is the
+        residual (vote, encode, bookkeeping), clamped at zero — the
+        five components always sum to the measured round wall."""
+        fit = self._cp_fit_s
+        agg = max(0.0, self.session.agg_wall_s - self._cp_agg_mark)
+        wire = min(self._cp_wire_s, self._cp_wait_s)
+        wait = self._cp_wait_s - wire
+        other = max(0.0, wall - fit - wait - wire - agg)
+        self.critpath_last = {
+            "round": round_no, "round_s": round(wall, 6),
+            "fit_s": round(fit, 6), "wire_s": round(wire, 6),
+            "wait_s": round(wait, 6), "agg_s": round(agg, 6),
+            "other_s": round(other, 6),
+        }
 
     def round_p95_s(self) -> float | None:
         """p95 of completed round wall times (None before the first
@@ -1940,7 +2039,10 @@ class P2PNode:
         pending, self._pending_params = self._pending_params, []
         for peer, msg in pending:
             if peer.idx in self.peers:
-                await self._on_params(peer, msg)
+                # inner entry: the rx span + wire-latency accrual fired
+                # at true arrival; replaying through the traced wrapper
+                # would double-count the frame's wire seconds
+                await self._on_params_inner(peer, msg)
             elif msg._slot is not None and self.sidecar is not None:
                 # the sender is gone; return its buffered payload's slot
                 self.sidecar.release(msg._slot)
@@ -2027,6 +2129,25 @@ class P2PNode:
         live token may have moved mid-round."""
         fanout = max(self.protocol.gossip_models_per_round, 1)
         loop = asyncio.get_event_loop()
+        # wait-on-quorum accounting: this loop's wall time, net of any
+        # aggregation that ran inside it (session.agg_wall_s delta) —
+        # partial-encode/gossip work in here is noise against the
+        # multi-second quorum waits the breakdown exists to expose
+        tw0 = time.monotonic()
+        agg0 = self.session.agg_wall_s
+        with self._tracer.span("node.wait", lane=self._lane,
+                               args={"round": self.round,
+                                     "kind": "gossip"}):
+            try:
+                await self._gossip_body(train_set, role,
+                                        leader_at_start, fanout, loop)
+            finally:
+                self._cp_wait_s += max(
+                    0.0, (time.monotonic() - tw0)
+                    - (self.session.agg_wall_s - agg0))
+
+    async def _gossip_body(self, train_set, role, leader_at_start,
+                           fanout, loop) -> None:
         last_status = None
         last_change_t = loop.time()
         deadline = loop.time() + self.session.timeout_s
@@ -2152,11 +2273,20 @@ class P2PNode:
             )
 
     async def _wait_done(self) -> None:
-        deadline = asyncio.get_event_loop().time() + self.session.timeout_s
-        while not self.session.done.is_set():
-            if asyncio.get_event_loop().time() > deadline:
-                break  # keep local params (timeout with nothing arrived)
-            await asyncio.sleep(self.gossip_period_s)
+        tw0 = time.monotonic()
+        with self._tracer.span("node.wait", lane=self._lane,
+                               args={"round": self.round,
+                                     "kind": "adopt"}):
+            try:
+                deadline = (asyncio.get_event_loop().time()
+                            + self.session.timeout_s)
+                while not self.session.done.is_set():
+                    if asyncio.get_event_loop().time() > deadline:
+                        # keep local params (timeout, nothing arrived)
+                        break
+                    await asyncio.sleep(self.gossip_period_s)
+            finally:
+                self._cp_wait_s += time.monotonic() - tw0
 
     async def _wait_neighbors_ready(self) -> None:
         """Round barrier: wait until every alive node we've heard from
@@ -2169,20 +2299,28 @@ class P2PNode:
         de-serialized — the whole async speedup would die at the
         barrier. Stragglers left behind catch up via the stale-params
         fold (see _on_params)."""
-        deadline = asyncio.get_event_loop().time() + self.session.timeout_s
-        frac = self.session.min_received
-        while asyncio.get_event_loop().time() < deadline:
-            alive = set(self.membership.get_nodes())
-            known = set(self.peers) | set(self.progress)
-            others = [i for i in alive & known if i != self.idx]
-            behind = [
-                i for i in others
-                if self._progress(i).ready_round < self.round
-            ]
-            if not behind:
-                return
-            if self.session.async_mode and others:
-                need = max(1, math.ceil(frac * len(others)))
-                if len(others) - len(behind) >= need:
-                    return
-            await asyncio.sleep(self.gossip_period_s)
+        tw0 = time.monotonic()
+        with self._tracer.span("node.wait", lane=self._lane,
+                               args={"round": self.round,
+                                     "kind": "barrier"}):
+            try:
+                deadline = (asyncio.get_event_loop().time()
+                            + self.session.timeout_s)
+                frac = self.session.min_received
+                while asyncio.get_event_loop().time() < deadline:
+                    alive = set(self.membership.get_nodes())
+                    known = set(self.peers) | set(self.progress)
+                    others = [i for i in alive & known if i != self.idx]
+                    behind = [
+                        i for i in others
+                        if self._progress(i).ready_round < self.round
+                    ]
+                    if not behind:
+                        return
+                    if self.session.async_mode and others:
+                        need = max(1, math.ceil(frac * len(others)))
+                        if len(others) - len(behind) >= need:
+                            return
+                    await asyncio.sleep(self.gossip_period_s)
+            finally:
+                self._cp_wait_s += time.monotonic() - tw0

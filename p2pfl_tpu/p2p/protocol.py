@@ -138,6 +138,13 @@ class Message:
     # ORIGIN, not the relaying connection (see p2p.tls).
     sig: bytes = b""
     cert: bytes = b""
+    # causal trace context (round 18): (trace_id, parent_span_id,
+    # send_wall_ns), stamped by the sender ONLY when its tracer is
+    # enabled. None keeps the encoded header byte-identical to the
+    # pre-tc format, so legacy peers and the untraced path are
+    # unchanged; the key is outside signing_bytes() — observability
+    # metadata, not authenticated content.
+    tc: tuple | None = None
     # framed-header memo: a broadcast/relay writes the SAME message to
     # up to n-1 peers, and per-peer re-encoding was ~10% of the socket
     # federation's CPU (scripts/exp_socket_profile.py). Set on first
@@ -214,20 +221,22 @@ class Message:
             ph = self._payload_digest
             if ph is None and self.sig:
                 ph = self.payload_digest()  # signed: digest is canonical
-            header = msgpack.packb(
-                {
-                    "v": WIRE_VERSION,
-                    "t": self.type.value,
-                    "s": self.sender,
-                    "b": self.body,
-                    "i": self.msg_id,
-                    "g": self.sig,
-                    "c": self.cert,
-                    "pl": len(self.payload),
-                    "ph": ph or b"",
-                },
-                use_bin_type=True,
-            )
+            head_obj = {
+                "v": WIRE_VERSION,
+                "t": self.type.value,
+                "s": self.sender,
+                "b": self.body,
+                "i": self.msg_id,
+                "g": self.sig,
+                "c": self.cert,
+                "pl": len(self.payload),
+                "ph": ph or b"",
+            }
+            if self.tc is not None:
+                # appended last so a tc-less message encodes to the
+                # exact pre-tc byte sequence (pinned by test)
+                head_obj["tc"] = list(self.tc)
+            header = msgpack.packb(head_obj, use_bin_type=True)
             if len(header) > MAX_HEADER:
                 raise ValueError(f"header too large: {len(header)} bytes")
             if len(self.payload) > MAX_FRAME:
@@ -258,6 +267,7 @@ class Message:
                 f"unsupported wire version {obj.get('v')!r} "
                 f"(this node speaks v{WIRE_VERSION})"
             )
+        tc = obj.get("tc")
         msg = Message(
             type=MsgType(obj["t"]),
             sender=int(obj["s"]),
@@ -266,6 +276,8 @@ class Message:
             msg_id=obj.get("i", ""),
             sig=obj.get("g", b""),
             cert=obj.get("c", b""),
+            # absent on legacy/untraced frames → None, parsed unchanged
+            tc=tuple(tc) if tc else None,
         )
         # Seed the digest cache from the header ONLY for unsigned
         # messages (plaintext federations): it saves a relay hash and

@@ -85,6 +85,11 @@ class AggregationSession:
         #: round-start params of the session's owner — the delta
         #: reference for reputation scoring (set_reference per round)
         self.reference: Params | None = None
+        #: cumulative seconds spent fusing models (numpy/device/sidecar
+        #: paths alike) — always-on plain-float accounting; the node's
+        #: per-round critical-path snapshot diffs a round-start mark
+        #: against it, so it deliberately survives clear()
+        self.agg_wall_s = 0.0
         self.models: dict[frozenset[int], tuple[Params, float]] = {}
         self.train_set: frozenset[int] = frozenset()
         self.waiting = False
@@ -146,14 +151,21 @@ class AggregationSession:
 
     # -- adding models ---------------------------------------------------
     def add_model(self, params: Params, contributors, weight: float,
-                  staleness: float = 0.0) -> tuple[int, ...]:
+                  staleness: float = 0.0,
+                  parent: str | None = None) -> tuple[int, ...]:
         """Returns the contributors now covered (broadcast as
         MODELS_AGGREGATED, node.py:363-369). Empty tuple = rejected.
 
         ``staleness`` (rounds-behind, async mode) discounts the entry's
         weight by ``staleness_scale`` at entry time — see module doc.
+        ``parent`` is the sender's wire-propagated tx span id (the
+        ``tc`` header): when present, this span records it so the
+        merged trace carries a true cross-process causal edge.
         """
-        with self._tracer.span("session.add_model", lane=self._lane):
+        with self._tracer.span(
+            "session.add_model", lane=self._lane,
+            args={"parent": parent} if parent is not None else None,
+        ):
             if staleness > 0.0 and self.staleness_beta > 0.0:
                 weight = float(weight) * float(
                     staleness_scale(staleness, self.staleness_beta)
@@ -294,6 +306,7 @@ class AggregationSession:
             weights = weights * self.reputation.entry_scales(keys)
         if type(self.aggregator) is FedAvg:
             return self._aggregate_numpy(entries, weights)
+        t0 = time.perf_counter()
         with self._tracer.span(
             "session.aggregate", lane=self._lane,
             args={"path": "stacked_device", "n": len(entries)},
@@ -302,7 +315,9 @@ class AggregationSession:
                 [jax.tree.map(np.asarray, p) for p, _ in entries]
             )
             agg = self.aggregator(stacked, weights)
-            return jax.tree.map(np.asarray, agg), (), float(weights.sum())
+            out = jax.tree.map(np.asarray, agg), (), float(weights.sum())
+        self.agg_wall_s += time.perf_counter() - t0
+        return out
 
     def _aggregate_numpy(self, entries, weights):
         # Host fast path. Models in the socket session are host
@@ -315,12 +330,14 @@ class AggregationSession:
         # mean is shape-oblivious and stays off-device. The kernel
         # itself lives in p2p.aggd (fuse_numpy) so the sidecar worker
         # runs the IDENTICAL code — tolerance-0 parity by sharing.
+        t0 = time.perf_counter()
         with self._tracer.span(
             "session.aggregate", lane=self._lane,
             args={"path": "numpy_fast", "n": len(entries)},
         ):
             tree, total = fuse_numpy([p for p, _ in entries], weights)
-            return tree, (), total
+        self.agg_wall_s += time.perf_counter() - t0
+        return tree, (), total
 
     def clear(self) -> None:
         """Reset for the next round (aggregator.py:231-238)."""
@@ -386,7 +403,8 @@ class SidecarSession(AggregationSession):
 
     # -- adding models ---------------------------------------------------
     def add_model(self, params: Params, contributors, weight: float,
-                  staleness: float = 0.0) -> tuple[int, ...]:
+                  staleness: float = 0.0,
+                  parent: str | None = None) -> tuple[int, ...]:
         """Tree entry point — the node's OWN model (and the waiting
         adoption path, which defers to the base class). The tree is
         encoded into a leased slot so every fuse entry is slot-backed;
@@ -394,8 +412,11 @@ class SidecarSession(AggregationSession):
         ships to the worker through the descriptor queue."""
         if self.waiting:
             return super().add_model(params, contributors, weight,
-                                     staleness)
-        with self._tracer.span("session.add_model", lane=self._lane):
+                                     staleness, parent=parent)
+        with self._tracer.span(
+            "session.add_model", lane=self._lane,
+            args={"parent": parent} if parent is not None else None,
+        ):
             if staleness > 0.0 and self.staleness_beta > 0.0:
                 weight = float(weight) * float(
                     staleness_scale(staleness, self.staleness_beta)
@@ -416,13 +437,17 @@ class SidecarSession(AggregationSession):
             return covered
 
     def add_slot(self, slot: int, length: int, contributors,
-                 weight: float, staleness: float = 0.0) -> tuple[int, ...]:
+                 weight: float, staleness: float = 0.0,
+                 parent: str | None = None) -> tuple[int, ...]:
         """Slot-backed add: the payload stays undecoded in the arena.
         Takes ownership of the slot — a rejected entry's slot is
         released here, an accepted one when its fuse (or clear/crash
         cleanup) consumes it. Never valid on a waiting session (the
         node routes adoption payloads through the decode path)."""
-        with self._tracer.span("session.add_model", lane=self._lane):
+        with self._tracer.span(
+            "session.add_model", lane=self._lane,
+            args={"parent": parent} if parent is not None else None,
+        ):
             if staleness > 0.0 and self.staleness_beta > 0.0:
                 weight = float(weight) * float(
                     staleness_scale(staleness, self.staleness_beta)
@@ -434,13 +459,17 @@ class SidecarSession(AggregationSession):
             return covered
 
     def add_blob(self, blob, contributors, weight: float,
-                 staleness: float = 0.0) -> tuple[int, ...]:
+                 staleness: float = 0.0,
+                 parent: str | None = None) -> tuple[int, ...]:
         """Raw-wire-blob add — the arena was exhausted when the socket
         sink asked, so the payload arrived as loop-side bytes. It still
         never gets decoded here: a lease retry may land it in a slot
         freed since (rounds release in bursts), otherwise the blob
         itself ships to the worker through the descriptor queue."""
-        with self._tracer.span("session.add_model", lane=self._lane):
+        with self._tracer.span(
+            "session.add_model", lane=self._lane,
+            args={"parent": parent} if parent is not None else None,
+        ):
             if staleness > 0.0 and self.staleness_beta > 0.0:
                 weight = float(weight) * float(
                     staleness_scale(staleness, self.staleness_beta)
@@ -525,6 +554,7 @@ class SidecarSession(AggregationSession):
 
     async def _fuse_and_close(self, entries, weights, covered) -> None:
         loop = asyncio.get_running_loop()
+        t_fuse0 = time.perf_counter()
         n = len(entries)
         req = []
         for (p, _w), w in zip(entries, weights):
@@ -557,6 +587,7 @@ class SidecarSession(AggregationSession):
             flight.record("aggd.fallback", lane=self._lane, entries=n)
             params = await loop.run_in_executor(
                 None, self._fallback_fuse, entries, weights)
+        self.agg_wall_s += time.perf_counter() - t_fuse0
         self._release_entries(entries)
         self._publish(params, covered, n)
 

@@ -72,7 +72,7 @@ def read_statuses(directory: str | pathlib.Path) -> list[dict[str, Any]]:
 
 
 _COLUMNS = ("node", "role", "round", "loss", "accuracy", "trust",
-            "peers", "p95s", "io_mb", "age", "health")
+            "peers", "p95s", "wait%", "io_mb", "age", "health")
 
 
 def _health_cell(node: int | None, alerts) -> str:
@@ -86,6 +86,17 @@ def _health_cell(node: int | None, alerts) -> str:
     top = (crit or mine)[0]
     extra = f"+{len(mine) - 1}" if len(mine) > 1 else ""
     return f"{top.severity}({top.rule}{extra})"
+
+
+def _wait_cell(rec: dict[str, Any]) -> str:
+    """WAIT% cell from the critpath_* gauges launch.py publishes: the
+    fraction of the last closed round spent blocked on quorum/barrier.
+    Falls back to "-" for records predating a closed round (or from
+    builds without critical-path accounting)."""
+    wait, wall = rec.get("critpath_wait_s"), rec.get("critpath_round_s")
+    if wait is None or not wall:
+        return "-"
+    return f"{100.0 * float(wait) / float(wall):.0f}%"
 
 
 def _row(rec: dict[str, Any], now: float, liveness_s: float,
@@ -115,6 +126,10 @@ def _row(rec: dict[str, Any], now: float, liveness_s: float,
         # obs summaries (round-9): p95 round wall time + wire traffic
         # in/out MB — published by launch.py/scenario.py status loops
         "p95s": "-" if p95 is None else f"{float(p95):.2f}",
+        # round-18 critical path: share of the last round spent blocked
+        # on quorum/barrier (critpath_wait_s / critpath_round_s). "-"
+        # until the node closes a round with tracing-era gauges.
+        "wait%": _wait_cell(rec),
         "io_mb": (
             "-" if bi is None and bo is None
             else f"{(bi or 0) / 1e6:.1f}/{(bo or 0) / 1e6:.1f}"

@@ -361,6 +361,65 @@ def _svg_chart(title: str, series: dict[str, list], w: int = 460,
     )
 
 
+# per-round critical-path components -> bar colors (round-18 pane);
+# order matters: it is the stacking order of the bar segments
+_CRITPATH_COMPONENTS = (
+    ("fit", "critpath_fit_s", "#3987e5"),
+    ("wire", "critpath_wire_s", "#d95926"),
+    ("wait", "critpath_wait_s", "#c98500"),
+    ("agg", "critpath_agg_s", "#199e70"),
+    ("other", "critpath_other_s", "#898781"),
+)
+
+
+def critpath_pane(statuses: list[dict]) -> str:
+    """Per-round critical-path breakdown pane for the scenario page:
+    one row per node showing where its LAST closed round's wall went
+    (the ``critpath_*`` gauges launch.py publishes), with a stacked
+    proportional bar. Empty string until any node reports a closed
+    round — scenarios run untraced-era builds too."""
+    rows = []
+    for rec in statuses:
+        wall = rec.get("critpath_round_s")
+        if not wall:
+            continue
+        segs, cells = [], []
+        for label, key, color in _CRITPATH_COMPONENTS:
+            v = float(rec.get(key) or 0.0)
+            pct = 100.0 * v / float(wall)
+            segs.append(
+                f"<span style='display:inline-block;background:{color};"
+                f"height:10px;width:{pct:.1f}%' "
+                f"title='{label} {v:.3f}s ({pct:.0f}%)'></span>"
+            )
+            cells.append(f"<td>{v:.3f}</td>")
+        rows.append(
+            "<tr><td>{n}</td><td>{r}</td><td>{w:.3f}</td>{cells}"
+            "<td style='min-width:160px'><div style='width:160px;"
+            "background:#000'>{bar}</div></td></tr>".format(
+                n=rec.get("node", "?"),
+                r=rec.get("critpath_round", "?"),
+                w=float(wall), cells="".join(cells), bar="".join(segs),
+            )
+        )
+    if not rows:
+        return ""
+    legend = " ".join(
+        f"<span style='color:{color}'>&#9644;</span> {label}"
+        for label, _, color in _CRITPATH_COMPONENTS
+    )
+    head = "".join(
+        f"<th>{h}</th>"
+        for h in ("NODE", "ROUND", "ROUND_S", "FIT", "WIRE", "WAIT",
+                  "AGG", "OTHER", "")
+    )
+    return (
+        "<h3>round critical path</h3>"
+        f"<div style='font-size:11px'>{legend}</div>"
+        f"<table><tr>{head}</tr>{''.join(rows)}</table>"
+    )
+
+
 class Deployments:
     """Child processes launched through the run endpoint, by scenario
     name (the Controller-in-process role, app.py:679-681 — here a
@@ -1000,7 +1059,7 @@ class DashboardHandler(BaseHTTPRequestHandler):
         alerts, _ = evaluate_dir(safe, engine=HealthEngine())
         inner = render_alerts_html(alerts) + render_table_html(
             statuses, alerts=alerts
-        )
+        ) + critpath_pane(statuses)
         logs = sorted((safe / "logs").glob("*.log")) if (
             safe / "logs").is_dir() else []
         links = " | ".join(

@@ -84,7 +84,11 @@ def test_obs_phase_dry_run_emits_key_plan():
     assert len(parts) == 1 and parts[0]["obs_dry"] is True
     planned = set(parts[0]["obs_keys"])
     assert {"obs_overhead_pct", "obs_round_s_untraced",
-            "obs_round_s_traced", "obs_xla_recompiles"} <= planned
+            "obs_round_s_traced", "obs_xla_recompiles",
+            # round 18: the critical-path validation arm's keys ride
+            # the same plan
+            "critpath_wire_s_24node", "critpath_wait_s_24node",
+            "critpath_sum_err_pct_24node"} <= planned
     # every planned key must be registered (and, via
     # check_bench_keys, documented)
     assert planned <= set(bench.BENCH_KEYS)

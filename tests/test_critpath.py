@@ -1,0 +1,170 @@
+"""Critical-path attribution (round 18): the obs.critpath analyzer's
+per-round decomposition, pairwise clock-skew estimation, the causal
+flow events in the trace export, and traceview's torn-file tolerance."""
+
+import json
+
+import pytest
+
+from p2pfl_tpu.obs import critpath, traceview
+
+US = 1_000_000  # µs per second
+
+
+def _meta(pid, lane="node0"):
+    return [
+        {"ph": "M", "name": "process_name", "pid": pid, "tid": 0,
+         "args": {"name": f"proc{pid}"}},
+        {"ph": "M", "name": "thread_name", "pid": pid, "tid": 0,
+         "args": {"name": lane}},
+    ]
+
+
+def _x(name, pid, t0_s, dur_s, args=None):
+    ev = {"ph": "X", "name": name, "pid": pid, "tid": 0,
+          "ts": t0_s * US, "dur": dur_s * US}
+    if args is not None:
+        ev["args"] = args
+    return ev
+
+
+def _two_node_doc():
+    """node0 receives one PARAMS frame from node1 mid-round; every
+    component has a hand-computable value."""
+    events = _meta(1, "node0") + _meta(2, "node1") + [
+        # node0: 10 s round = 4 fit + 5 wait (0.5 of it aggregation,
+        # 0.5 of it wire) + 1 other
+        _x("node.round", 1, 0, 10, {"round": 0}),
+        _x("node.fit", 1, 0, 4),
+        _x("learner.fit", 1, 0.5, 3),  # nested: union must not double
+        _x("node.wait", 1, 4, 5, {"round": 0, "kind": "gossip"}),
+        _x("session.aggregate", 1, 8.5, 0.5),
+        _x("p2p.rx", 1, 6, 0.1,
+           {"parent": "B.1", "from": 1, "trace": "B", "round": 0,
+            "tx_ns": 0, "rx_ns": 500_000_000}),
+        # node1: 8 s round, sends at 5.5 s
+        _x("node.round", 2, 0, 8, {"round": 0}),
+        _x("learner.fit", 2, 0, 5),
+        _x("p2p.tx", 2, 5.5, 0.1, {"sid": "B.1", "round": 0}),
+    ]
+    return {"traceEvents": events, "metadata": {"files": 2}}
+
+
+def test_analyze_two_node_round_decomposition():
+    result = critpath.analyze(_two_node_doc())
+    nodes = result["rounds"][0]["nodes"]
+    n0 = nodes["node0"]
+    assert n0["round_s"] == pytest.approx(10.0)
+    assert n0["fit_s"] == pytest.approx(4.0)  # union, not 4 + 3
+    assert n0["agg_s"] == pytest.approx(0.5)
+    assert n0["wire_s"] == pytest.approx(0.5)  # rx_ns - tx_ns
+    # wait excludes the in-loop aggregation AND the wire share
+    assert n0["wait_s"] == pytest.approx(4.0)
+    assert n0["other_s"] == pytest.approx(1.0)
+    # five components sum to the round wall by construction
+    total = (n0["fit_s"] + n0["wire_s"] + n0["wait_s"] + n0["agg_s"]
+             + n0["other_s"])
+    assert total == pytest.approx(n0["round_s"])
+    n1 = nodes["node1"]
+    assert n1["fit_s"] == pytest.approx(5.0)
+    assert n1["wire_s"] == 0.0 and n1["wait_s"] == 0.0
+
+
+def test_longest_chain_hops_lanes_through_causal_edges():
+    chain = critpath.analyze(_two_node_doc())["rounds"][0]["chain"]
+    assert chain["tail_node"] == "node0"  # closes last (10 s vs 8 s)
+    segs = chain["segments"]
+    assert [s["node"] for s in segs] == ["node1", "node0"]
+    # node1 works from round start to its 5.5 s send, then node0 owns
+    # the path from the rx close (6.1 s) to its round close (10 s)
+    assert segs[0]["span_s"] == pytest.approx(5.5)
+    assert segs[1]["span_s"] == pytest.approx(3.9)
+    assert "rx from 1" in segs[1]["via"]
+    assert chain["total_s"] == pytest.approx(9.4)
+
+
+def test_skew_estimation_cancels_shared_floor():
+    """Both directions observed: offset(b-a) = (min_d_ab - min_d_ba)/2;
+    one direction only: offset falls back to 0 (documented caveat)."""
+    def rx(lane, frm, d_ns):
+        return {"name": "p2p.rx", "_lane": lane,
+                "args": {"from": frm, "tx_ns": 0, "rx_ns": d_ns}}
+
+    spans = [
+        rx("b", "a", 300_000_000), rx("b", "a", 400_000_000),  # a -> b
+        rx("a", "b", 100_000_000),                             # b -> a
+        rx("c", "a", 200_000_000),                             # one-way
+    ]
+    skew = critpath.estimate_skew(spans)
+    assert skew[("a", "b")] == pytest.approx(0.1)   # (0.3 - 0.1) / 2
+    assert skew[("b", "a")] == pytest.approx(-0.1)
+    assert skew[("a", "c")] == 0.0
+
+
+def test_cli_json_and_round_filter(tmp_path, capsys):
+    doc = _two_node_doc()
+    f = tmp_path / "proc1.trace.json"
+    f.write_text(json.dumps(
+        {"traceEvents": doc["traceEvents"],
+         "metadata": {"wall_t0": 100.0, "pid": 1}}))
+    assert critpath.main([str(tmp_path), "--json"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert set(out["rounds"]) == {"0"}
+    assert out["rounds"]["0"]["nodes"]["node0"]["fit_s"] == pytest.approx(4.0)
+    # --round with no matching spans: clean failure, not a stack trace
+    assert critpath.main([str(tmp_path), "--round", "7"]) == 1
+    # table mode renders the breakdown header + chain line
+    assert critpath.main([str(tmp_path)]) == 0
+    table = capsys.readouterr().out
+    assert "WIRE" in table and "longest chain" in table
+
+
+def test_cli_refuses_empty_dir(tmp_path, capsys):
+    assert critpath.main([str(tmp_path)]) == 1
+    assert "no readable trace files" in capsys.readouterr().err
+
+
+def test_export_emits_flow_events_for_span_ids(tmp_path):
+    """A span carrying a "sid" arg exports a flow source ("s"); one
+    carrying "parent" exports a binding ("f") — the Perfetto arrows
+    cross-process rx spans parent to."""
+    from p2pfl_tpu.obs.trace import Tracer
+
+    tr = Tracer()
+    tr.configure(enabled=True)
+    sid = tr.next_span_id()
+    assert sid.startswith(tr.trace_id + ".")
+    with tr.span("p2p.tx", lane=0, args={"sid": sid}):
+        pass
+    with tr.span("p2p.rx", lane=1, args={"parent": "ffff0000.3"}):
+        pass
+    path = tr.export(tmp_path / "proc.trace.json")
+    doc = json.loads(path.read_text())
+    flows = [e for e in doc["traceEvents"] if e["ph"] in ("s", "f")]
+    assert {e["ph"] for e in flows} == {"s", "f"}
+    assert next(e for e in flows if e["ph"] == "s")["id"] == sid
+    assert next(e for e in flows if e["ph"] == "f")["id"] == "ffff0000.3"
+
+
+def test_traceview_tolerates_zero_byte_and_torn_files(tmp_path, capsys):
+    good = tmp_path / "proc1.trace.json"
+    good.write_text(json.dumps({
+        "traceEvents": _meta(1) + [_x("node.round", 1, 0, 1,
+                                      {"round": 0})],
+        "metadata": {"wall_t0": 50.0, "pid": 1},
+    }))
+    (tmp_path / "proc2.trace.json").write_bytes(b"")  # crashed exporter
+    (tmp_path / "proc3.trace.json").write_text(
+        '{"traceEvents": [{"ph": "X", "na')  # torn mid-write
+    merged = traceview.merge(traceview.find_trace_files(tmp_path))
+    assert merged["metadata"]["files"] == 1  # bad files skipped
+    assert any(e.get("name") == "node.round"
+               for e in merged["traceEvents"])
+    out = tmp_path / "merged.json"
+    assert traceview.main([str(tmp_path), "-o", str(out)]) == 0
+    assert "skipping" in capsys.readouterr().err
+    # every file unreadable -> loud failure, not an empty document
+    bad = tmp_path / "allbad"
+    bad.mkdir()
+    (bad / "proc9.trace.json").write_bytes(b"")
+    assert traceview.main([str(bad), "-o", str(bad / "m.json")]) == 1

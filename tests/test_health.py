@@ -296,6 +296,47 @@ class TestFlightRecorder:
             rec.dump_dir, rec.enabled = old_dir, old_enabled
             rec.clear()
 
+    def test_crash_dump_stamps_active_trace_id(self, tmp_path):
+        """Round 18: with tracing in scope, flight events carry the
+        process trace_id, so a postmortem's control events can be
+        joined against the span timeline. The id must round-trip
+        through a real node.crash() dump; untraced events stay
+        unstamped (the always-on recorder adds no id noise)."""
+        from p2pfl_tpu.obs.trace import get_tracer
+        from p2pfl_tpu.p2p import P2PNode
+
+        tr = get_tracer()
+        rec = flight.get_recorder()
+        old_dir, old_enabled = rec.dump_dir, rec.enabled
+        old_traced = tr.enabled
+        rec.clear()
+        flight.configure(enabled=True, dump_dir=tmp_path)
+        try:
+            tr.configure(enabled=False)
+            rec.record("membership.suspect", node=1)  # untraced era
+            tr.configure(enabled=True)
+
+            async def main():
+                _, learners = _make_learners(2, samples=40)
+                node = P2PNode(0, learners[0], role="aggregator",
+                               n_nodes=2)
+                await node.crash()
+
+            asyncio.run(main())
+            dump = tmp_path / f"flight_{os.getpid()}.json"
+            assert dump.exists()
+            doc = json.loads(dump.read_text())
+            crash = next(e for e in doc["events"]
+                         if e["kind"] == "node.crash")
+            assert crash["trace"] == tr.trace_id
+            suspect = next(e for e in doc["events"]
+                           if e["kind"] == "membership.suspect")
+            assert "trace" not in suspect
+        finally:
+            tr.configure(enabled=old_traced)
+            rec.dump_dir, rec.enabled = old_dir, old_enabled
+            rec.clear()
+
 
 # ---------------------------------------------------------------------------
 # bench regression gate

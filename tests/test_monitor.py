@@ -90,6 +90,32 @@ def test_render_table_html_dead_row_styling(tmp_path):
         assert f"<th>{col}</th>" in frag
 
 
+def test_wait_pct_column_terminal_and_html(tmp_path):
+    """Round 18: the WAIT% column renders critpath_wait_s as a share
+    of critpath_round_s, and falls back to "-" for records without
+    critical-path gauges (pre-round-18 publishers, or a node that has
+    not closed a round yet)."""
+    from p2pfl_tpu.utils.monitor import render_table_html
+
+    publish_status(tmp_path, 0, {"role": "aggregator", "round": 2,
+                                 "critpath_round": 1,
+                                 "critpath_round_s": 2.0,
+                                 "critpath_fit_s": 1.0,
+                                 "critpath_wire_s": 0.1,
+                                 "critpath_wait_s": 0.8,
+                                 "critpath_agg_s": 0.05,
+                                 "critpath_other_s": 0.05})
+    publish_status(tmp_path, 1, {"role": "trainer", "round": 2})
+    table = render_table(read_statuses(tmp_path))
+    lines = table.splitlines()
+    assert lines[0].split()[8] == "WAIT%"
+    assert lines[2].split()[8] == "40%"  # 0.8 / 2.0
+    assert lines[3].split()[8] == "-"  # no critpath data published
+    frag = render_table_html(read_statuses(tmp_path))
+    assert "<th>WAIT%</th>" in frag
+    assert "<td>40%</td>" in frag
+
+
 def test_watch_once_writes_both_outputs(tmp_path, capsys):
     from p2pfl_tpu.utils.monitor import watch
 

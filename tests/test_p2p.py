@@ -91,6 +91,58 @@ class TestProtocol:
 
         asyncio.run(main())
 
+    def test_trace_context_rides_header_and_roundtrips(self):
+        """Round 18: a traced sender's (trace_id, parent_span_id,
+        send_wall_ns) rides the header and comes back as a tuple."""
+
+        async def main():
+            tc = ("ab12cd34", "ab12cd34.7", 1722400000000000000)
+            m = Message(MsgType.PARAMS, 0, {"round": 1}, payload=b"x",
+                        tc=tc)
+            out = await read_message(_fed_reader(m.encode()))
+            assert out.tc == tc
+            assert out.body == {"round": 1}
+            assert out.payload == b"x"
+
+        asyncio.run(main())
+
+    def test_untraced_frame_byte_identical_and_legacy_tc_less_parses(self):
+        """P2PFL_TRACE=0 acceptance: a message without a trace context
+        encodes to the EXACT pre-round-18 byte sequence (no "tc" key,
+        no size change), and that tc-less frame — what every legacy
+        peer sends — parses unchanged with ``tc is None``."""
+        from p2pfl_tpu.p2p.protocol import WIRE_MAGIC, WIRE_VERSION
+
+        m = Message(MsgType.PARAMS, 3, {"round": 2}, payload=b"pp",
+                    msg_id="id")
+        frame = m.encode()
+        # hand-built pre-tc v2 frame: the header key set and order are
+        # part of the wire contract
+        head = msgpack.packb(
+            {"v": WIRE_VERSION, "t": MsgType.PARAMS.value, "s": 3,
+             "b": {"round": 2}, "i": "id", "g": b"", "c": b"",
+             "pl": 2, "ph": b""},
+            use_bin_type=True,
+        )
+        assert frame == WIRE_MAGIC + struct.pack(">I", len(head)) + head + b"pp"
+        out = Message.decode(frame)
+        assert out.tc is None
+        assert out.body == {"round": 2}
+        # a traced frame differs ONLY by the appended tc key
+        mt = Message(MsgType.PARAMS, 3, {"round": 2}, payload=b"pp",
+                     msg_id="id", tc=("ab", "ab.1", 1))
+        assert mt.encode() != frame
+        assert Message.decode(mt.encode()).tc == ("ab", "ab.1", 1)
+
+    def test_tc_outside_signature(self):
+        """The trace context is unauthenticated observability metadata:
+        signing_bytes() must not cover it, so a TLS relay can neither
+        break a signature by stripping tc nor need to re-sign."""
+        a = Message(MsgType.PARAMS, 1, {"round": 0}, payload=b"z")
+        b = Message(MsgType.PARAMS, 1, {"round": 0}, payload=b"z",
+                    tc=("ff", "ff.9", 42))
+        assert a.signing_bytes() == b.signing_bytes()
+
     def test_payload_reaches_writer_uncopied(self):
         """Zero-copy send: the exact payload bytes object must reach
         the transport (as a memoryview over it), never a copy."""
@@ -630,8 +682,17 @@ def test_multiprocess_launch(tmp_path, monkeypatch):
     assert set(merged) == {"traceEvents", "displayTimeUnit", "metadata"}
     assert merged["metadata"]["files"] == 2
     events = merged["traceEvents"]
-    assert {e["ph"] for e in events} <= {"M", "X", "C"}
+    # "s"/"f" are the causal flow events (round 18): a p2p.tx on the
+    # sender links to the p2p.rx / session.add_model it caused
+    assert {e["ph"] for e in events} <= {"M", "X", "C", "s", "f"}
     assert len({e["pid"] for e in events}) == 2
+    # cross-process parent edges: at least one flow id is emitted as a
+    # source ("s") in one process and bound ("f") in the OTHER — the
+    # PARAMS exchange crossed a process boundary and kept its causality
+    src = {e["id"]: e["pid"] for e in events if e["ph"] == "s"}
+    dst = [(e["id"], e["pid"]) for e in events if e["ph"] == "f"]
+    assert src and dst
+    assert any(i in src and src[i] != pid for i, pid in dst)
     lanes = {e["args"]["name"] for e in events
              if e["ph"] == "M" and e["name"] == "thread_name"}
     assert {"node0", "node1", "node2", "node3"} <= lanes
@@ -643,6 +704,69 @@ def test_multiprocess_launch(tmp_path, monkeypatch):
     assert len(by_pid) == 2
     assert all(any(k.startswith("rx_bytes/") for k in c)
                for c in by_pid.values())
+
+
+def test_mixed_version_federation_converges():
+    """Legacy-peer compatibility (round 18): nodes 1 and 3 run with a
+    disabled tracer — they never stamp ``tc`` and ignore incoming trace
+    contexts, exactly like peers on a pre-tc build — while nodes 0 and
+    2 trace. The 4-node federation must converge identically, and the
+    traced pair must still record cross-node parent edges between
+    themselves."""
+    from p2pfl_tpu.obs.trace import Tracer, get_tracer
+
+    async def main():
+        n = 4
+        fed, learners = _make_learners(n, samples=60)
+        nodes = [
+            P2PNode(i, learners[i], role="aggregator", n_nodes=n,
+                    protocol=_PROTO, gossip_period_s=0.02)
+            for i in range(n)
+        ]
+        # "old build" nodes: a private, never-enabled tracer
+        legacy = Tracer()
+        legacy.configure(enabled=False)
+        nodes[1]._tracer = legacy
+        nodes[3]._tracer = legacy
+        for node in nodes:
+            await node.start()
+        for i in range(n):
+            for j in range(i + 1, n):
+                await nodes[i].connect_to(nodes[j].host, nodes[j].port)
+        nodes[0].learner.init()
+        nodes[0].set_start_learning(rounds=2, epochs=1)
+        await asyncio.wait_for(
+            asyncio.gather(*(node.finished.wait() for node in nodes)),
+            timeout=120,
+        )
+        try:
+            assert all(node.round == 2 for node in nodes)
+            p0 = np.asarray(
+                nodes[0].learner.get_parameters()["params"]["Dense_2"]["kernel"])
+            p1 = np.asarray(
+                nodes[1].learner.get_parameters()["params"]["Dense_2"]["kernel"])
+            np.testing.assert_allclose(p0, p1, rtol=1e-4, atol=1e-5)
+            # the traced pair exchanged real causal edges: at least one
+            # rx span parented to a tx span id this process minted
+            spans = get_tracer().spans()
+            tx_ids = {(s[4] or {}).get("sid") for s in spans
+                      if s[0] == "p2p.tx"}
+            rx_parents = {(s[4] or {}).get("parent") for s in spans
+                          if s[0] == "p2p.rx"}
+            assert tx_ids & rx_parents
+        finally:
+            for node in nodes:
+                await node.stop()
+
+    tr = get_tracer()
+    was = tr.enabled
+    tr.configure(enabled=True)
+    tr.reset()
+    try:
+        asyncio.run(main())
+    finally:
+        tr.configure(enabled=was)
+        tr.reset()
 
 
 def test_eight_node_socket_federation_with_vote_cap():

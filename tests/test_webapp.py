@@ -75,6 +75,42 @@ def test_live_node_page_and_metrics(server):
     assert recs and recs[-1]["Train/loss"] == 0.5
 
 
+def test_critpath_pane_renders_when_gauges_present(tmp_path):
+    """Round 18: the scenario page grows a per-round breakdown pane
+    once any status record carries critpath_* gauges; pane and unit
+    function both stay silent without them."""
+    from p2pfl_tpu.utils.monitor import read_statuses
+    from p2pfl_tpu.webapp import critpath_pane
+
+    publish_status(tmp_path / "cp" / "status", 0,
+                   {"role": "aggregator", "round": 2,
+                    "critpath_round": 1, "critpath_round_s": 2.0,
+                    "critpath_fit_s": 1.2, "critpath_wire_s": 0.2,
+                    "critpath_wait_s": 0.4, "critpath_agg_s": 0.1,
+                    "critpath_other_s": 0.1})
+    publish_status(tmp_path / "cp" / "status", 1,
+                   {"role": "trainer", "round": 2})  # no gauges yet
+    statuses = read_statuses(tmp_path / "cp" / "status")
+    pane = critpath_pane(statuses)
+    assert "round critical path" in pane
+    assert "<th>WIRE</th>" in pane and "<th>WAIT</th>" in pane
+    assert "1.200" in pane and "0.400" in pane
+    # only node 0 has a closed round: one data row
+    assert pane.count("<tr>") == 2  # header + node 0
+    # no gauges anywhere -> no pane at all
+    assert critpath_pane([{"node": 1, "round": 2}]) == ""
+
+    srv = make_server(tmp_path, port=0)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        _, body = _get(
+            f"http://127.0.0.1:{srv.server_address[1]}/scenario/cp")
+        assert "round critical path" in body
+    finally:
+        srv.shutdown()
+
+
 def test_log_viewer_and_404s(server):
     status, body = _get(server + "/logs/alpha/node_0.log")
     assert status == 200 and "webapp log line" in body

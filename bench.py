@@ -127,7 +127,8 @@ def _build(n: int, *, dataset="femnist", model="femnist-cnn",
            exchange_dtype="bf16", exchange_overlap="off", seed=0,
            model_kwargs=None, shared_aggregate=False,
            surrogate_profile="hard",
-           attack=None, malicious=None, reputation=False):
+           attack=None, malicious=None, reputation=False,
+           lora=None):
     """Assemble one federated configuration into compiled programs.
 
     Returns a dict of everything the timing/trajectory helpers need.
@@ -161,7 +162,19 @@ def _build(n: int, *, dataset="femnist", model="femnist-cnn",
         n,
     )
     x, y, smask, nsamp = ds.stacked()
-    fns = make_step_fns(get_model(model, **(model_kwargs or {})),
+    mdl = get_model(model, **(model_kwargs or {}))
+    if lora:
+        # adapter-only federation: the unit of federation becomes the
+        # adapter pytree — every downstream consumer (round fn, Krum
+        # Gram, wire bytes) shrinks to adapter size without changing.
+        # ``base`` pins the frozen weights (the lora phase's pretrain
+        # handoff); absent, it derives deterministically from seed.
+        from p2pfl_tpu.learning.lora import wrap_model
+        mdl = wrap_model(mdl, model, lora["rank"],
+                         targets=tuple(lora.get("targets") or ()),
+                         alpha=lora.get("alpha"), base=lora.get("base"),
+                         seed=seed, sample_x=x[0, :1])
+    fns = make_step_fns(mdl,
                         optimizer=optimizer, learning_rate=learning_rate,
                         momentum_dtype=momentum_dtype,
                         batch_size=batch_size)
@@ -1045,6 +1058,17 @@ _AGGD_KEYS = (
     "aggd_accuracy_sidecar", "aggd_accuracy_inline",
 )
 
+# keys the lora phase (round 19: adapter-only federation A/B) emits;
+# static so BENCH_KEYS and the P2PFL_LORA_DRY plan stay authoritative
+_LORA_KEYS = (
+    "lora_rank", "lora_n_nodes", "lora_rounds",
+    "lora_adapter_bytes_per_round", "lora_full_bytes_per_round",
+    "lora_payload_reduction",
+    "lora_krum_round_s", "lora_full_krum_round_s",
+    "lora_final_accuracy", "lora_full_final_accuracy",
+    "lora_accuracy_gap", "lora_xla_recompiles",
+)
+
 # Authoritative registry of every top-level key bench can emit.
 # scripts/check_bench_keys.py asserts each one is documented in
 # docs/perf.md (§10 key reference) and that no emission site uses a
@@ -1099,6 +1123,8 @@ BENCH_KEYS = (
     "chaos_dry", "chaos_keys", *_CHAOS_KEYS,
     # aggd (round 15: shared-memory aggregation sidecar A/B)
     "aggd_dry", "aggd_keys", *_AGGD_KEYS,
+    # lora (round 19: adapter-only federation A/B)
+    "lora_dry", "lora_keys", *_LORA_KEYS,
     # run-metadata stamp (round 12 regression gate provenance)
     "meta",
     # orchestration-test hook
@@ -1346,6 +1372,161 @@ def _phase_robust() -> None:
         except Exception as e:
             print(f"robust variant {key} failed: {e!r}"[:300],
                   file=sys.stderr, flush=True)
+
+
+def _lora_pretrain_base(n: int, rounds: int):
+    """Shared frozen base for the lora A/B: a plain FedAvg
+    fully-connected federation trained ``rounds`` rounds, node-0 row
+    taken as THE base both arms fine-tune from (same_init + FedAvg on
+    a complete graph keeps every row identical, so node 0 is the
+    federation). Host-copied so the build can be freed before the
+    arms allocate their own states."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    run = _build(n, dataset="cifar10", model="vit-tiny",
+                 topology="fully", partition="iid",
+                 samples_per_node=256, batch_size=64,
+                 learning_rate=1e-3, optimizer="adam", seed=4,
+                 surrogate_profile="easy",
+                 model_kwargs={"remat": True, "scan_layers": True})
+    fed, fargs, round_fn = run["fed"], run["fargs"], run["round_fn"]
+    for _ in range(rounds):
+        fed, m = round_fn(fed, *fargs)
+    float(jnp.sum(m["train_loss"]))
+    base = jax.tree.map(lambda l: np.asarray(l[0]), fed.states.params)
+    del fed
+    run.clear()
+    jax.clear_caches()
+    return base
+
+
+def _lora_arm(base, lora_cfg, n: int, rounds: int, reps: int = 3) -> dict:
+    """One fine-tune arm of the lora A/B: Krum(f=1, m=3) federation
+    resumed from the pretrained ``base`` — the full-weight arm adopts
+    it via ``reseed_params``, the adapter arm's zero-init merged model
+    IS the base bit-exactly (B=0). Returns the arm's steady-state
+    round time, per-round wire-equivalent payload bytes (node-0
+    envelope x n — what a fully-connected socket round ships), final
+    accuracy after ``rounds`` total rounds, and the post-warm-up XLA
+    recompile count."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from p2pfl_tpu.core.aggregators import Krum
+    from p2pfl_tpu.core.serialize import encode_parameters
+    from p2pfl_tpu.obs import trace as obs_trace
+    from p2pfl_tpu.parallel.federated import build_eval_fn, reseed_params
+
+    run = _build(n, dataset="cifar10", model="vit-tiny",
+                 topology="fully", aggregator=Krum(f=1, m=3),
+                 partition="iid", samples_per_node=256, batch_size=64,
+                 learning_rate=1e-3, optimizer="adam", seed=4,
+                 surrogate_profile="easy", shared_aggregate=True,
+                 model_kwargs={"remat": True, "scan_layers": True},
+                 lora=lora_cfg)
+    tr = run["tr"]
+    if lora_cfg is None:
+        run["fed"] = tr.put_stacked(
+            reseed_params(run["fed"], run["fns"], base))
+    fed, fargs, round_fn = run["fed"], run["fargs"], run["round_fn"]
+    row0 = jax.tree.map(lambda l: np.asarray(l[0]), fed.states.params)
+    payload = len(encode_parameters(jax.tree.leaves(row0)))
+    del row0
+    fed, m = round_fn(fed, *fargs)  # warm-up: compile + first round
+    float(jnp.sum(m["train_loss"]))
+    obs_trace.reset_xla_counters()
+    done, ts = 1, []
+    for _ in range(reps):
+        t0 = time.monotonic()
+        fed, m = round_fn(fed, *fargs)
+        float(jnp.sum(m["train_loss"]))
+        ts.append(time.monotonic() - t0)
+        done += 1
+    while done < rounds:  # finish the fine-tune budget untimed
+        fed, m = round_fn(fed, *fargs)
+        done += 1
+    float(jnp.sum(m["train_loss"]))
+    recompiles = obs_trace.xla_recompiles()
+    eval_jit = jax.jit(build_eval_fn(run["fns"]))
+    ds = run["ds"]
+    xt = tr.put_replicated(jnp.asarray(ds.x_test[:2000]))
+    yt = tr.put_replicated(jnp.asarray(ds.y_test[:2000]))
+    acc = float(np.mean(np.asarray(eval_jit(fed, xt, yt)["accuracy"])))
+    del fed, xt, yt
+    run.clear()
+    jax.clear_caches()
+    return {"round_s": float(np.median(ts)), "bytes": payload * n,
+            "acc": acc, "recompiles": recompiles}
+
+
+def _phase_lora() -> None:
+    """Adapter-only federation A/B (round 19): vit-tiny, 16 nodes,
+    fully connected, Krum(f=1, m=3) — full-weight federation vs LoRA
+    adapter federation (rank 8, q/v targets), both fine-tuning from
+    the SAME pretrained base so the accuracy comparison isolates what
+    federation ships. ``lora_payload_reduction`` is the wire-
+    equivalent bytes ratio (~73x at rank 8: the adapter tree is what
+    every consumer — FedAvg contraction, Krum Gram, socket envelope —
+    sees); ``lora_krum_round_s`` vs ``lora_full_krum_round_s`` shows
+    the robust phase shrinking with it. Arms run interleaved
+    (min-of-2) under the perf-gate pairing discipline; each run
+    streams a partial part so a mid-phase kill keeps the earlier arm.
+
+    ``P2PFL_LORA_DRY=1`` emits the key plan without touching the
+    accelerator — the orchestration test's smoke hook."""
+    n, rank, pre_rounds, ft_rounds = 16, 8, 10, 10
+    if os.environ.get("P2PFL_LORA_DRY") == "1":
+        _part({"lora_dry": True, "lora_keys": list(_LORA_KEYS),
+               "lora_rank": rank, "lora_n_nodes": n,
+               "lora_rounds": ft_rounds})
+        return
+
+    base = _lora_pretrain_base(n, pre_rounds)
+
+    def run_full():
+        return _lora_arm(base, None, n, ft_rounds)
+
+    def run_lora():
+        # the adapter arm's frozen base IS the pretrained snapshot:
+        # zero-init adapters make its merged round-0 model bit-equal
+        # to the full arm's reseeded starting point
+        return _lora_arm(base, {"rank": rank, "base": base}, n, ft_rounds)
+
+    def on_run(tag, i, r):
+        if not r:
+            return
+        if tag == "a":
+            _part({"lora_full_krum_round_s": round(r["round_s"], 4),
+                   "lora_full_bytes_per_round": r["bytes"],
+                   "lora_full_final_accuracy": round(r["acc"], 4)})
+        else:
+            _part({"lora_krum_round_s": round(r["round_s"], 4),
+                   "lora_adapter_bytes_per_round": r["bytes"],
+                   "lora_final_accuracy": round(r["acc"], 4),
+                   "lora_xla_recompiles": r["recompiles"]})
+
+    best_full, best_lora = _ab_interleaved(run_full, run_lora, pairs=2,
+                                           key="round_s", on_run=on_run)
+    part = {"lora_rank": rank, "lora_n_nodes": n,
+            "lora_rounds": ft_rounds}
+    if best_full:
+        part["lora_full_krum_round_s"] = round(best_full["round_s"], 4)
+        part["lora_full_bytes_per_round"] = best_full["bytes"]
+        part["lora_full_final_accuracy"] = round(best_full["acc"], 4)
+    if best_lora:
+        part["lora_krum_round_s"] = round(best_lora["round_s"], 4)
+        part["lora_adapter_bytes_per_round"] = best_lora["bytes"]
+        part["lora_final_accuracy"] = round(best_lora["acc"], 4)
+        part["lora_xla_recompiles"] = best_lora["recompiles"]
+    if best_full and best_lora:
+        part["lora_payload_reduction"] = round(
+            best_full["bytes"] / best_lora["bytes"], 2)
+        part["lora_accuracy_gap"] = round(
+            best_full["acc"] - best_lora["acc"], 4)
+    _part(part)
 
 
 def _phase_obs() -> None:
@@ -2534,6 +2715,7 @@ def main() -> None:
         ("cross_device", "_phase_cross_device", 120),
         ("chaos", "_phase_chaos", 120),
         ("aggd", "_phase_aggd", 120),
+        ("lora", "_phase_lora", 150),
         ("vit32", "_phase_vit32", 120),
     ]
     for name, fn, min_s in phases:

@@ -1,6 +1,7 @@
 from p2pfl_tpu.config.schema import (
     DataConfig,
     FaultEvent,
+    LoraConfig,
     ModelConfig,
     NodeConfig,
     ProtocolConfig,
@@ -11,6 +12,7 @@ from p2pfl_tpu.config.schema import (
 __all__ = [
     "DataConfig",
     "FaultEvent",
+    "LoraConfig",
     "ModelConfig",
     "NodeConfig",
     "ProtocolConfig",

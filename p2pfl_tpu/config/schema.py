@@ -410,6 +410,50 @@ class CrossDeviceConfig:
 
 
 @dataclasses.dataclass
+class LoraConfig:
+    """Adapter-only federation (learning.lora): the unit of federation
+    becomes the LoRA adapter delta instead of the full parameter tree.
+
+    ``rank == 0`` (default) keeps full-weight federation. When active,
+    every node trains only the adapter subtree of a frozen base derived
+    deterministically from ``(model config, scenario seed)`` — the
+    optimizer state, the SPMD mix/Krum Gram, the socket wire envelopes
+    (incl. bf16/int8 wire dtypes + error feedback), reputation scoring
+    and checkpoints all shrink to adapter size because each is generic
+    over "params".
+
+    ``targets`` are substring patterns matched against kernel paths;
+    empty means the model's registered defaults
+    (``models.base.register_lora_targets`` — e.g. q/v attention
+    projections for ViT). ``alpha`` is the usual LoRA scale numerator
+    (``None`` = ``rank``, i.e. scale 1.0).
+    """
+
+    rank: int = 0  # 0 = off (full-weight federation)
+    targets: list[str] = dataclasses.field(default_factory=list)
+    alpha: float | None = None
+
+    def __post_init__(self):
+        if self.rank < 0:
+            raise ValueError(f"lora rank must be >= 0, got {self.rank}")
+        if self.alpha is not None and self.alpha <= 0:
+            raise ValueError(
+                f"lora alpha must be > 0, got {self.alpha}"
+            )
+        if self.targets and not all(
+            isinstance(t, str) and t for t in self.targets
+        ):
+            raise ValueError(
+                f"lora targets must be non-empty strings, got "
+                f"{self.targets!r}"
+            )
+
+    @property
+    def active(self) -> bool:
+        return self.rank > 0
+
+
+@dataclasses.dataclass
 class NodeConfig:
     """Per-node overrides (device_args in the reference), including the
     round-11 compute class: ``epochs`` overrides the federation-wide
@@ -461,6 +505,13 @@ class ScenarioConfig:
     cross_device: CrossDeviceConfig = dataclasses.field(
         default_factory=CrossDeviceConfig
     )
+    # adapter-only federation (round 19): when active, nodes exchange
+    # LoRA adapter trees over a frozen shared base instead of full
+    # weights — see LoraConfig. Composes with wire dtypes, staged
+    # overlap, adversary/reputation and robust aggregators; the
+    # refusal matrix in __post_init__ rejects the planes that would
+    # silently fuse full weights.
+    lora: LoraConfig = dataclasses.field(default_factory=LoraConfig)
     # weight-exchange collective schedule: "dense" = all-gather einsum;
     # "sparse" = per-edge-offset ppermute (O(degree) ICI traffic, DFL +
     # one node per device only); "auto" picks sparse when it is legal
@@ -591,6 +642,26 @@ class ScenarioConfig:
                     "aggregation_plane='sidecar' is a socket-plane "
                     "feature; cross_device runs the cohort-scan round"
                 )
+        if self.lora.active:
+            # adapter-only refusal matrix: fail loud on any plane that
+            # would silently federate FULL weights while the scenario
+            # says adapters (the sparse-transport refusal idiom).
+            if self.aggregation_plane == "sidecar":
+                raise ValueError(
+                    "lora composes with aggregation_plane='inline' "
+                    "only for now: the sidecar fuses raw slot bytes "
+                    "against full-weight expectations and would "
+                    "silently aggregate adapter envelopes as if they "
+                    "were full models"
+                )
+            if self.cross_device.active:
+                raise ValueError(
+                    "lora is not wired into the cross_device "
+                    "cohort-scan round yet: it would silently train "
+                    "full weights while the scenario says adapters"
+                )
+            # staged exchange overlap composes: the double buffer
+            # carries whatever tree the learner trains — adapters.
         if not self.nodes:
             self.nodes = self._default_nodes()
         if len(self.nodes) != self.n_nodes:
@@ -679,6 +750,7 @@ class ScenarioConfig:
             ("adversary", AdversaryConfig),
             ("elastic", ElasticConfig),
             ("cross_device", CrossDeviceConfig),
+            ("lora", LoraConfig),
         ]:
             if field in d and isinstance(d[field], dict):
                 d[field] = cls(**d[field])

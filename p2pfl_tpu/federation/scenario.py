@@ -96,6 +96,18 @@ class Scenario(Observable):
         n = config.n_nodes
         self.dataset = dataset or FederatedDataset.make(config.data, n)
         self.model = build_model(config.model)
+        if config.lora.active:
+            # adapter-only federation: the wrapped model trains (and
+            # federates) the adapter subtree over a frozen base derived
+            # deterministically from (model config, seed) — the SAME
+            # derivation every socket node process uses, so the planes
+            # share one base bit-exactly
+            from p2pfl_tpu.learning.lora import maybe_wrap_lora
+
+            self.model = maybe_wrap_lora(
+                self.model, config,
+                jnp.asarray(self.dataset.nodes[0].x[:1]),
+            )
         self.fns = make_step_fns(
             self.model,
             objective=config.model.objective,

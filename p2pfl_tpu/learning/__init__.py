@@ -17,6 +17,13 @@ from p2pfl_tpu.learning.objectives import (
     get_objective,
 )
 from p2pfl_tpu.learning.learner import JaxLearner, NodeLearner, TrainState
+from p2pfl_tpu.learning.lora import (
+    LoraModel,
+    lora_init,
+    maybe_wrap_lora,
+    merge_adapters,
+    split_adapters,
+)
 
 __all__ = [
     "cross_entropy_loss",
@@ -27,4 +34,9 @@ __all__ = [
     "JaxLearner",
     "NodeLearner",
     "TrainState",
+    "LoraModel",
+    "lora_init",
+    "maybe_wrap_lora",
+    "merge_adapters",
+    "split_adapters",
 ]

@@ -14,6 +14,45 @@ import flax.linen as nn
 
 _REGISTRY: dict[str, Callable[..., nn.Module]] = {}
 
+# per-model LoRA adapter-target metadata (learning.lora): default
+# target patterns plus each pattern's (out_axes, base_ndim) kernel
+# view — how many trailing axes are outputs and how many axes the
+# unscanned kernel has (extra leading axes broadcast, e.g. nn.scan's
+# depth axis). Registered next to the factory because the split is a
+# property of the architecture, not of any one scenario.
+_LORA_TARGETS: dict[str, tuple[tuple[str, ...], dict[str, tuple[int, int]]]] = {}
+
+
+def register_lora_targets(*names: str, default: tuple[str, ...],
+                          specs: dict[str, tuple[int, int]] | None = None
+                          ) -> None:
+    """Register a model's default LoRA targets + kernel axis specs."""
+    entry = (tuple(default), dict(specs or {}))
+    for name in names:
+        _LORA_TARGETS[name.lower()] = entry
+
+
+def default_lora_targets(name: str) -> tuple[str, ...]:
+    """A model's registered default adapter targets. Loud when the
+    model registers none — silently adapting nothing (or guessing
+    kernels) would report a fine-tune that never ran; the scenario
+    must then set ``lora.targets`` explicitly."""
+    entry = _LORA_TARGETS.get(name.lower())
+    if entry is None or not entry[0]:
+        raise ValueError(
+            f"model {name!r} registers no default lora targets "
+            f"(have {sorted(_LORA_TARGETS)}); set lora.targets "
+            "explicitly"
+        )
+    return entry[0]
+
+
+def lora_axis_specs(name: str) -> dict[str, tuple[int, int]]:
+    """Per-pattern (out_axes, base_ndim) kernel views; patterns absent
+    here fall back to the plain 2-D ``(..., d_in, d_out)`` view."""
+    entry = _LORA_TARGETS.get(name.lower())
+    return dict(entry[1]) if entry else {}
+
 
 def register_model(*names: str):
     """Decorator registering a model factory under one or more names."""

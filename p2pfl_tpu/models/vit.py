@@ -15,7 +15,7 @@ from typing import Any
 import flax.linen as nn
 import jax.numpy as jnp
 
-from p2pfl_tpu.models.base import register_model
+from p2pfl_tpu.models.base import register_lora_targets, register_model
 
 
 class TransformerBlock(nn.Module):
@@ -129,3 +129,19 @@ class ViT(nn.Module):
 @register_model("vit-tiny", "vit")
 def _vit_tiny(num_classes: int = 10, **kw) -> ViT:
     return ViT(num_classes=num_classes, **kw)
+
+
+# Adapter targets (learning.lora): default is the classic q/v pair —
+# the smallest split that fine-tunes attention. Axis specs give each
+# kernel's (out_axes, base_ndim) view: q/k/v kernels are
+# [dim, heads, head_dim] (two output axes), the out projection is
+# [heads, head_dim, dim], MLP Dense kernels are plain [d_in, d_out],
+# patch_embed is a Conv [kh, kw, cin, cout]. Under scan_layers every
+# block kernel gains a leading [depth] axis, which the lora matmul
+# broadcasts over — per-layer adapters in one contraction.
+register_lora_targets(
+    "vit-tiny", "vit",
+    default=("query", "value"),
+    specs={"query": (2, 3), "key": (2, 3), "value": (2, 3),
+           "out": (1, 3), "Dense": (1, 2), "patch_embed": (1, 4)},
+)

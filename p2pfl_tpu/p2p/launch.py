@@ -195,8 +195,11 @@ async def _run_node(cfg: ScenarioConfig, idx: int, ports: list[int],
     data = FederatedDataset.make(cfg.data, n)  # deterministic: same shards
     adv_kwargs = _node_adversary_kwargs(cfg, idx, data,
                                         _adversary_setup(cfg))
+    from p2pfl_tpu.learning.lora import maybe_wrap_lora
+
     learner = JaxLearner(
-        model=build_model(cfg.model),
+        model=maybe_wrap_lora(build_model(cfg.model), cfg,
+                              data.nodes[idx].x[:1]),
         data=data.nodes[idx],
         objective=cfg.model.objective,
         optimizer=cfg.training.optimizer,
@@ -397,9 +400,11 @@ async def _simulate(cfg: ScenarioConfig, timeout: float = 600) -> dict:
     data = FederatedDataset.make(cfg.data, n)
     topo = generate_topology(cfg.topology, n, **cfg.topology_kwargs)
     from p2pfl_tpu.learning.learner import SharedTrainer
+    from p2pfl_tpu.learning.lora import maybe_wrap_lora
 
     shared = SharedTrainer(
-        build_model(cfg.model), objective=cfg.model.objective,
+        maybe_wrap_lora(build_model(cfg.model), cfg, data.nodes[0].x[:1]),
+        objective=cfg.model.objective,
         optimizer=cfg.training.optimizer,
         learning_rate=cfg.training.learning_rate,
         momentum=cfg.training.momentum,

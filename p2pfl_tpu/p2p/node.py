@@ -1084,6 +1084,11 @@ class P2PNode:
         cp = (msg.tc[1]
               if self._tracer.enabled and msg.tc is not None else None)
         if msg.body.get("init"):
+            # whoever pushes initial weights evidently HAS the model —
+            # count them initialized even if their MODEL_INITIALIZED
+            # flood was lost or predates our connection, or our own
+            # diffusion loop would chase their ack until its deadline
+            self._progress(msg.sender).initialized = True
             if not self.initialized:
                 payload = decode_parameters(msg.payload)
                 self.learner.set_parameters(payload.params)
@@ -1686,8 +1691,14 @@ class P2PNode:
             )
         )
         # initial model diffusion (node.py:299): push our weights until
-        # every peer reports initialized
+        # every peer reports initialized. The starter must flood its own
+        # MODEL_INITIALIZED too: an adopter re-diffuses until EVERY peer
+        # — starter included — reports initialized, and nothing else
+        # ever acks the starter, so a node that enters its learning
+        # loop already-adopted would block in _diffuse_initial for the
+        # whole aggregation timeout waiting on it.
         self.initialized = True
+        await self.broadcast(Message(MsgType.MODEL_INITIALIZED, self.idx))
         self._start_learning(rounds, epochs, leader=self.idx)
 
     def _start_learning(self, rounds, epochs, leader=None) -> None:

@@ -185,6 +185,30 @@ def init_federation(
     )
 
 
+def reseed_params(fed: FederatedState, fns: StepFns,
+                  params: Params) -> FederatedState:
+    """Restart a federation from ONE param tree: every node adopts
+    ``params`` with FRESH optimizer state (``fns.tx.init`` per node),
+    keeping rng/step/alive/round. The pretrain -> fine-tune handoff of
+    the lora bench phase: both A/B arms resume from the identical
+    full-weight (or adapter) snapshot, so their accuracies differ only
+    by what federation ships, not by where training started."""
+    n = fed.alive.shape[0]
+    stack = jax.tree.map(
+        lambda x: jnp.broadcast_to(
+            jnp.asarray(x), (n,) + jnp.shape(jnp.asarray(x))
+        ).copy(),
+        params,
+    )
+    states = TrainState(
+        params=stack,
+        opt_state=jax.vmap(fns.tx.init)(stack),
+        rng=fed.states.rng,
+        step=fed.states.step,
+    )
+    return fed.replace(states=states)
+
+
 def with_staged_buffer(fed: FederatedState) -> FederatedState:
     """Seed the staged-exchange double buffer: the CURRENT params at
     ZERO contribution weight. The first staged round then mixes nothing

@@ -51,6 +51,7 @@ HEADLINE: dict[str, str] = {
     "chaos_recovery_s": "lower",
     "chaos_final_accuracy": "higher",
     "aggd_round_s_24node_uncapped": "lower",
+    "lora_payload_reduction": "higher",
 }
 DEFAULT_TOL = 0.15
 

@@ -408,6 +408,33 @@ def main() -> None:
           flops=n * n * P * 2, bytes_moved=n * P * 2 * 2,
           eff=tile_eff(64, 128))
 
+    # ---- LoRA adapter GEMMs at vit32 widths (round 19) ---------------
+    # The adapter-only federation's extra per-step compute: the rank-r
+    # bottleneck pair x@A [T,d]@[d,r] then @B [T,r]@[r,d] at ViT-Tiny's
+    # attention width (192) and MLP width (768), 16 nodes vmapped,
+    # T = 115 batch x 64 tokens (the lora bench phase's shapes). The
+    # thin [.,r] tiles fill at most r/128 of the MXU lanes — these rows
+    # price that tax against the HBM floor. Diagnostic only: vit-shaped
+    # ops have no line in the femnist round composition below.
+    T = 115 * 64
+    nl = 16
+    for d in (192, 768):
+        for r in (4, 8, 16):
+            xl = jax.random.normal(key, (nl, T, d), dt)
+            al = jax.random.normal(key, (nl, d, r), dt)
+            bl = jax.random.normal(key, (nl, r, d), dt)
+
+            def lora_fwd(c):
+                x, a, bb = c
+                y = jnp.einsum("ntr,nrd->ntd",
+                               jnp.einsum("ntd,ndr->ntr", x, a), bb)
+                return y + x, a, bb
+
+            probe(f"lora gemm d{d} r{r}", lora_fwd, (xl, al, bl),
+                  flops=nl * T * 2 * d * r * 2,
+                  bytes_moved=nl * (2 * T * d + T * r + 2 * d * r) * 2,
+                  eff=tile_eff(d, r))
+
     # ---- summary ------------------------------------------------------
     print("\nround composition (2 steps/epoch at b336):")
     diagnostic = ("conv1 fwd packed4", "fedavg mix einsum",
@@ -416,7 +443,8 @@ def main() -> None:
                   "dense1 bwd pallas",
                   "conv2 fwd pallas", "conv2 wgrad pallas",
                   "sgd update fused pallas")
-    per_step = [r for r in rows if r[0] not in diagnostic]
+    per_step = [r for r in rows if r[0] not in diagnostic
+                and not r[0].startswith("lora ")]
     meas = sum(r[1] for r in per_step)
     floor = sum(r[4] for r in per_step)
     print(f"  per-step measured sum {meas:.1f} ms, achievable floor "

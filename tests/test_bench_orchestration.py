@@ -31,7 +31,7 @@ def test_zero_budget_still_emits_parseable_json():
     assert set(out["skipped_phases"]) == {
         "headline", "cifar16", "cpu8", "socket24", "comm", "socket_mp",
         "obs", "obs_health", "robust", "elastic", "cross_device",
-        "chaos", "aggd", "vit32"
+        "chaos", "aggd", "lora", "vit32"
     }
     # the provenance stamp (round 12) rides the envelope even at zero
     # budget — a regression report must always name its commit
@@ -245,6 +245,32 @@ def test_aggd_phase_dry_run_emits_key_plan():
     assert {"aggd_round_s_24node_uncapped",
             "aggd_inline_round_s_24node_uncapped",
             "aggd_loop_payload_touch_bytes", "aggd_speedup"} <= planned
+    assert planned <= set(bench.BENCH_KEYS)
+
+
+def test_lora_phase_dry_run_emits_key_plan():
+    """P2PFL_LORA_DRY=1: the lora phase must emit its planned key list
+    as one parseable part without touching jax — the round-19 analog
+    of the aggd dry-run hook."""
+    env = dict(os.environ, P2PFL_LORA_DRY="1")
+    code = (f"import sys; sys.path.insert(0, {str(REPO)!r})\n"
+            "import bench; bench._phase_lora()\n")
+    res = subprocess.run([sys.executable, "-c", code],
+                         capture_output=True, text=True, timeout=60,
+                         env=env, cwd=REPO)
+    assert res.returncode == 0, res.stderr[-500:]
+    sys.path.insert(0, str(REPO))
+    import bench
+
+    parts = [json.loads(line[len(bench._PART_TAG):])
+             for line in res.stdout.splitlines()
+             if line.startswith(bench._PART_TAG)]
+    assert len(parts) == 1 and parts[0]["lora_dry"] is True
+    planned = set(parts[0]["lora_keys"])
+    assert {"lora_adapter_bytes_per_round", "lora_full_bytes_per_round",
+            "lora_payload_reduction", "lora_krum_round_s",
+            "lora_full_krum_round_s", "lora_final_accuracy",
+            "lora_accuracy_gap", "lora_xla_recompiles"} <= planned
     assert planned <= set(bench.BENCH_KEYS)
 
 

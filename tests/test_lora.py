@@ -1,0 +1,348 @@
+"""Adapter-only federation (learning.lora): the unit of federation
+becomes the adapter delta.
+
+Pins the tentpole invariants: zero-init merge is bit-exact
+(``W + 0.0 == W``), the split/merge structural round-trip survives the
+checkpoint msgpack path (owning copies), Krum over adapter trees picks
+the same winner as Krum over the materialized full weights under a
+25% sign-flip, and the SPMD and socket planes derive bit-identical
+adapter state from the same config (tolerance 0 — the uint8-view
+comparison idiom of test_adversary.py)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from p2pfl_tpu.config.schema import (
+    DataConfig,
+    LoraConfig,
+    ModelConfig,
+    ScenarioConfig,
+)
+from p2pfl_tpu.learning.lora import (
+    LoraModel,
+    base_params_for,
+    find_adapter_sites,
+    lora_init,
+    maybe_wrap_lora,
+    merge_adapters,
+    split_adapters,
+    wrap_model,
+)
+
+
+def _bitwise_equal(a, b) -> bool:
+    a, b = np.asarray(a), np.asarray(b)
+    return (a.shape == b.shape and a.dtype == b.dtype
+            and np.array_equal(a.view(np.uint8), b.view(np.uint8)))
+
+
+def _assert_trees_bitwise(t1, t2):
+    l1, l2 = jax.tree.leaves(t1), jax.tree.leaves(t2)
+    assert len(l1) == len(l2)
+    for a, b in zip(l1, l2):
+        assert _bitwise_equal(a, b)
+
+
+def _mlp_and_sample():
+    from p2pfl_tpu.models import get_model
+
+    model = get_model("mlp")
+    x = np.zeros((1, 28, 28, 1), np.float32)
+    return model, x
+
+
+# -- wrapper basics ---------------------------------------------------
+
+
+def test_merged_equals_base_bitwise_at_init():
+    """B=0 => base + (alpha/rank)*A@B == base bit-exactly — the anchor
+    every cross-plane parity argument stands on."""
+    model, x = _mlp_and_sample()
+    wrapped = wrap_model(model, "mlp", rank=4, targets=("Dense",),
+                         sample_x=x, seed=3)
+    base = base_params_for(model, 3, x)
+    adapters = wrapped.init(jax.random.PRNGKey(0), x)
+    _assert_trees_bitwise(base, wrapped.materialize(adapters))
+    # and the model output agrees bit-for-bit
+    out_full = model.apply(base, jnp.asarray(x))
+    out_lora = wrapped.apply(adapters, jnp.asarray(x))
+    assert _bitwise_equal(out_full, out_lora)
+
+
+def test_adapter_tree_is_orders_smaller():
+    model, x = _mlp_and_sample()
+    wrapped = wrap_model(model, "mlp", rank=4, targets=("Dense",),
+                         sample_x=x)
+    full = sum(int(np.prod(l.shape))
+               for l in jax.tree.leaves(wrapped.base))
+    # ~37x on the small mlp; the >=50x acceptance gate is vit-tiny's
+    # (test_vit_registry_defaults_resolve_scanned_qv pins that one)
+    assert full / wrapped.adapter_param_count() > 30
+
+
+def test_unmatched_target_raises_naming_kernels():
+    model, x = _mlp_and_sample()
+    params = base_params_for(model, 0, x)
+    with pytest.raises(ValueError, match="no_such_layer.*kernel"):
+        find_adapter_sites(params, ("no_such_layer",))
+    with pytest.raises(ValueError, match="must not be empty"):
+        find_adapter_sites(params, ())
+
+
+def test_vit_registry_defaults_resolve_scanned_qv():
+    """The registered vit-tiny defaults (q/v, axis specs) must resolve
+    the scanned kernels with their semantic d_in/d_out — [depth, 192,
+    3, 64] is one 192->192 projection per layer, not a 36864-wide
+    flatten."""
+    from p2pfl_tpu.models import get_model
+
+    model = get_model("vit-tiny", remat=True, scan_layers=True)
+    x = np.zeros((1, 32, 32, 3), np.float32)
+    wrapped = wrap_model(model, "vit-tiny", rank=8, sample_x=x, seed=4)
+    assert len(wrapped.sites) == 2  # query + value
+    for site in wrapped.sites:
+        assert site.lead == (12,)
+        assert site.d_in == 192 and site.d_out == 192
+    full = sum(int(np.prod(l.shape))
+               for l in jax.tree.leaves(wrapped.base))
+    assert full / wrapped.adapter_param_count() > 50
+
+
+def test_lora_model_unknown_model_raises_listing_registered():
+    model, x = _mlp_and_sample()
+    with pytest.raises(ValueError, match="no default lora targets"):
+        wrap_model(model, "mlp", rank=4, sample_x=x)  # no defaults
+
+
+# -- split/merge + checkpoint round-trip ------------------------------
+
+
+def test_split_merge_roundtrip_through_checkpoint_msgpack():
+    """The combined lora tree must survive pack_model/unpack_model
+    (the STATE_SYNC / node-checkpoint msgpack path, owning-copy leaves)
+    and split back out bit-exactly."""
+    from p2pfl_tpu.federation.checkpoint import pack_model, unpack_model
+
+    model, x = _mlp_and_sample()
+    params = base_params_for(model, 1, x)
+    tree = lora_init(params, 4, ("Dense",),
+                     rng=jax.random.PRNGKey(9))
+    base, adapters = split_adapters(tree)
+    remerged = merge_adapters(base, adapters)
+    assert (jax.tree.structure(remerged) == jax.tree.structure(tree))
+    _assert_trees_bitwise(tree, remerged)
+
+    blob = pack_model(tree, round_num=5)
+    restored, rnd = unpack_model(blob, tree)
+    assert rnd == 5
+    _assert_trees_bitwise(tree, restored)
+    # restored leaves own their memory (donation-safe, round-9 law)
+    for leaf in jax.tree.leaves(restored):
+        assert np.asarray(leaf).flags["OWNDATA"]
+
+    rb, ra = split_adapters(restored)
+    _assert_trees_bitwise(base, rb)
+    _assert_trees_bitwise(adapters, ra)
+
+
+def test_split_adapters_rejects_non_lora_tree():
+    with pytest.raises(ValueError, match="not a lora tree"):
+        split_adapters({"params": {}})
+    with pytest.raises(ValueError, match="not a lora tree"):
+        split_adapters([1, 2])
+
+
+# -- Krum on adapters vs Krum on full weights -------------------------
+
+
+def test_krum_same_winner_on_adapters_and_full_under_signflip():
+    """25% sign-flip (scale 10): Krum(m=1) over the adapter stack must
+    select the same node as Krum over the materialized full-weight
+    stack — the [n,n] Gram shrinks to adapter size without changing
+    the robust decision. m=1 returns the winner row exactly (one-hot
+    weighted mean), so same-winner is assertable bitwise."""
+    from p2pfl_tpu.core.aggregators import Krum
+
+    model, x = _mlp_and_sample()
+    base = base_params_for(model, 0, x)
+    wrapped = LoraModel(model, base, rank=2, targets=("Dense",))
+
+    n, rng = 8, np.random.RandomState(7)
+    per_node = []
+    for i in range(n):
+        ad = wrapped.init(jax.random.PRNGKey(0), x)
+        # distinct benign updates: small per-node noise on A and B
+        ad = jax.tree.map(
+            lambda l: np.asarray(l)
+            + 0.01 * rng.randn(*l.shape).astype(np.float32), ad)
+        per_node.append(ad)
+    for i in (2, 5):  # 25% malicious: sign-flip scale 10 on shipped tree
+        per_node[i] = jax.tree.map(lambda l: np.asarray(l) * -10.0,
+                                   per_node[i])
+    stacked_ad = jax.tree.map(lambda *ls: jnp.stack(ls), *per_node)
+    stacked_full = jax.tree.map(
+        lambda *ls: jnp.stack(ls),
+        *[wrapped.materialize(ad) for ad in per_node])
+
+    w = jnp.ones((n,), jnp.float32)
+    krum = Krum(f=2, m=1)
+    win_ad = krum.aggregate(stacked_ad, w)
+    win_full = krum.aggregate(stacked_full, w)
+    _assert_trees_bitwise(wrapped.materialize(win_ad), win_full)
+
+
+# -- cross-plane parity (tolerance 0) ---------------------------------
+
+
+def test_spmd_and_socket_adapter_federation_parity_tolerance_0():
+    """Same config => both planes agree at tolerance 0 on everything
+    that federates: the merged round-0 model (zero-init B makes it the
+    shared base bit-exactly on BOTH planes — the vmapped SPMD init and
+    the socket learner's jitted init may differ by 1 ULP in the never-
+    federation-visible A@0 factor's A, which the B=0 merge erases), the
+    zero B leaves themselves, and an SPMD adapter row shipped through
+    the socket wire envelope and adopted via ``set_parameters``."""
+    from p2pfl_tpu.core.serialize import decode_parameters, encode_parameters
+    from p2pfl_tpu.datasets import FederatedDataset
+    from p2pfl_tpu.learning.learner import JaxLearner, make_step_fns
+    from p2pfl_tpu.models.base import build_model
+    from p2pfl_tpu.parallel.federated import init_federation
+
+    dc = DataConfig(dataset="mnist", samples_per_node=32, batch_size=8)
+    data = FederatedDataset.make(dc, 2)
+    cfg = ScenarioConfig(name="parity", n_nodes=2,
+                         model=ModelConfig(model="mlp"), data=dc,
+                         seed=11,
+                         lora=LoraConfig(rank=4, targets=["Dense"]))
+    model = maybe_wrap_lora(build_model(cfg.model), cfg,
+                            data.nodes[0].x[:1])
+
+    # SPMD plane
+    fns = make_step_fns(model, batch_size=8)
+    fed = init_federation(fns, jnp.asarray(data.nodes[0].x[:1]), 2,
+                          seed=cfg.seed)
+    row0 = jax.tree.map(lambda l: np.asarray(l[0]), fed.states.params)
+
+    # socket plane
+    lrn = JaxLearner(model=model, data=data.nodes[0], batch_size=8,
+                     seed=cfg.seed)
+    lrn.init()
+    sock = lrn.get_parameters()
+
+    # merged round-0 models bit-identical (== the shared frozen base)
+    _assert_trees_bitwise(model.materialize(row0),
+                          model.materialize(sock))
+    _assert_trees_bitwise(model.materialize(row0), model.base)
+    # the B factors are zeros on both planes
+    for site in model.sites:
+        assert _bitwise_equal(row0[site.key]["B"], sock[site.key]["B"])
+
+    # an SPMD row through the socket wire + adoption: bit-exact
+    blob = encode_parameters(jax.tree.leaves(row0))
+    back = decode_parameters(blob).params
+    for a, b in zip(jax.tree.leaves(row0), back):
+        assert _bitwise_equal(a, b)
+    lrn.set_parameters(row0)
+    _assert_trees_bitwise(row0, lrn.get_parameters())
+
+
+def test_both_planes_share_one_frozen_base():
+    """``base_params_for`` depends on the sample's shape/dtype only —
+    different node shards derive the SAME base (what lets separate
+    socket processes agree without shipping it)."""
+    model, _ = _mlp_and_sample()
+    r = np.random.RandomState(0)
+    b1 = base_params_for(model, 5, r.rand(1, 28, 28, 1).astype(np.float32))
+    b2 = base_params_for(model, 5, r.rand(1, 28, 28, 1).astype(np.float32))
+    _assert_trees_bitwise(b1, b2)
+
+
+# -- config refusal matrix --------------------------------------------
+
+
+def test_lora_config_validation():
+    with pytest.raises(ValueError, match="rank"):
+        LoraConfig(rank=-1)
+    with pytest.raises(ValueError, match="alpha"):
+        LoraConfig(rank=4, alpha=0.0)
+    with pytest.raises(ValueError, match="targets"):
+        LoraConfig(rank=4, targets=[""])
+    assert not LoraConfig().active
+    assert LoraConfig(rank=8).active
+
+
+def test_lora_refuses_sidecar_plane():
+    with pytest.raises(ValueError, match="sidecar"):
+        ScenarioConfig(name="x", n_nodes=2,
+                       aggregation_plane="sidecar",
+                       lora=LoraConfig(rank=4, targets=["Dense"]))
+
+
+def test_lora_refuses_cross_device():
+    from p2pfl_tpu.config.schema import CrossDeviceConfig
+
+    with pytest.raises(ValueError, match="cross_device"):
+        ScenarioConfig(name="x", n_nodes=2,
+                       cross_device=CrossDeviceConfig(n_clients=100),
+                       lora=LoraConfig(rank=4, targets=["Dense"]))
+
+
+def test_lora_composes_with_staged_overlap_and_from_dict():
+    cfg = ScenarioConfig.from_dict({
+        "name": "ok", "n_nodes": 2,
+        "exchange_overlap": "staged",
+        "lora": {"rank": 8, "targets": ["query", "value"],
+                 "alpha": 16.0},
+    })
+    assert cfg.lora.active and cfg.lora.rank == 8
+    assert cfg.lora.alpha == 16.0
+    assert cfg.lora.targets == ["query", "value"]
+
+
+# -- satellite: get_objective loud failure ----------------------------
+
+
+def test_get_objective_unknown_name_lists_valid_names():
+    from p2pfl_tpu.learning.objectives import get_objective
+
+    with pytest.raises(ValueError, match="unknown objective"):
+        get_objective("nope")
+    try:
+        get_objective("nope")
+    except ValueError as e:
+        assert "classification" in str(e)
+
+
+# -- socket plane e2e: no init-handshake stall ------------------------
+
+
+def test_socket_lora_federation_completes_without_init_stall():
+    """Adapter-only socket federation must finish in seconds, not at
+    the 60 s aggregation deadline. Regression pin for the init
+    handshake: the starter floods MODEL_INITIALIZED at kickoff and an
+    init-params sender counts as initialized — without either, a peer
+    that adopts BEFORE its learning loop checks ``initialized`` blocks
+    the whole of ``_diffuse_initial``'s deadline waiting for an ack
+    the starter never sent (lora's slower learner init loses that race
+    deterministically; full-weight runs win it by luck)."""
+    from p2pfl_tpu.config import TrainingConfig
+    from p2pfl_tpu.p2p.launch import run_simulation
+
+    out = run_simulation(ScenarioConfig(
+        name="lora-sock", n_nodes=4, topology="fully",
+        model=ModelConfig(model="mlp"),
+        data=DataConfig(dataset="mnist", samples_per_node=60,
+                        batch_size=32),
+        training=TrainingConfig(rounds=2, learning_rate=1e-3,
+                                optimizer="adam"),
+        seed=3, lora=LoraConfig(rank=4, targets=["Dense"])))
+    assert out["rounds"] == 2
+    # the stall signature was wall_s ~= 60 (one aggregation_timeout_s
+    # burned in round 0) — a healthy run is a few seconds of jit + fit
+    assert out["wall_s"] < 30.0, out
+    # and the wire carries adapters, not full models: the 2-round full
+    # arm moves tens of MB here, the adapter arm well under 5 MB
+    assert 0 < out["params_bytes_out"] < 5_000_000, out

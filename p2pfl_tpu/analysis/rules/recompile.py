@@ -15,6 +15,14 @@ wall time). Three mechanical signatures cover what actually happened:
    ``high_water(f"...")`` allocates a fresh key string per frame even
    when tracing is off. Hot paths must gate under
    ``if tracer.enabled:`` so the disabled path is allocation-free.
+4. **device_put in a loop** (round 20): ``jax.device_put`` inside a
+   per-round/per-cohort loop body serializes a host→device copy into
+   every iteration — the transfer rides the critical path instead of
+   overlapping the previous step's compute. Hoist the placement out of
+   the loop, or route it through the sanctioned double-buffered
+   prefetch seam (``scenario.py``'s streamed ``gather_put``, which
+   carries the line pragma) so the copy for cohort t+1 runs while
+   cohort t trains.
 """
 
 from __future__ import annotations
@@ -96,6 +104,16 @@ def _check(ctx) -> Iterator:
                 "with tracing off; gate the call under "
                 "'if tracer.enabled:' so the disabled path is "
                 "allocation-free")
+        elif (tail == "device_put"
+              and dotted_name(node.func) in {"device_put",
+                                             "jax.device_put"}
+              and inside_loop(ctx, node)):
+            yield ctx.finding(
+                NAME, node,
+                "jax.device_put inside a loop serializes a host->device "
+                "copy into every iteration; hoist the placement out of "
+                "the loop or route it through the double-buffered "
+                "prefetch seam so the copy overlaps compute")
 
 
 RECOMPILE_HAZARD = Rule(

@@ -361,6 +361,21 @@ class CrossDeviceConfig:
     # by the tolerance-0 parity gate — this is a perf knob, not a
     # semantics knob)
     accumulate: str = "fused"
+    # round-20 device-scaling knob: split the cohort scan's C steps
+    # into this many contiguous chunks, one per device of a cohort
+    # mesh (parallel.mesh.cohort_shard_mesh). Part of the round's
+    # SEMANTICS, not just layout — each chunk trains from the
+    # round-start carry — so 1 (default) reproduces the round-13 scan
+    # exactly and D>1 is bit-identical between the sharded and
+    # single-device arms. Requires cohort_size % cohort_shards == 0.
+    cohort_shards: int = 1
+    # round-20 streaming knob: "stream" drives the round through
+    # build_cross_device_stream_fns with a double-buffered host→device
+    # prefetch (at most TWO cohorts of client data resident, any N)
+    # instead of materializing all C cohorts up front. Bit-identical
+    # to "off" (same body, same order); orthogonal to cohort_shards
+    # and not composed with it in this round.
+    prefetch: str = "off"
     seed: int = 0
 
     def __post_init__(self):
@@ -374,10 +389,26 @@ class CrossDeviceConfig:
                 f"unknown accumulate {self.accumulate!r}; "
                 "have ('fused', 'unfused')"
             )
+        if self.prefetch not in ("off", "stream"):
+            raise ValueError(
+                f"unknown prefetch {self.prefetch!r}; "
+                "have ('off', 'stream')"
+            )
+        if self.cohort_shards < 1:
+            raise ValueError(
+                f"cohort_shards must be >= 1, got {self.cohort_shards}"
+            )
         if self.n_clients < 0:
             raise ValueError(f"n_clients must be >= 0, got {self.n_clients}")
         if not self.active:
             return
+        if self.prefetch == "stream" and self.cohort_shards > 1:
+            raise ValueError(
+                "cross_device prefetch='stream' does not compose with "
+                "cohort_shards > 1: the streamed driver feeds one "
+                "cohort step at a time, the sharded scan wants all "
+                "chunks resident — pick one axis"
+            )
         if self.clients_per_round < 1:
             raise ValueError(
                 "cross_device needs clients_per_round >= 1 "
@@ -397,6 +428,12 @@ class CrossDeviceConfig:
                 f"clients_per_round={self.clients_per_round} must be a "
                 f"multiple of cohort_size={self.cohort_size} (the round "
                 "scans cohort_size waves of equal width)"
+            )
+        if self.cohort_size % self.cohort_shards:
+            raise ValueError(
+                f"cohort_size={self.cohort_size} must be a multiple of "
+                f"cohort_shards={self.cohort_shards} (each device scans "
+                "an equal contiguous chunk of the cohort axis)"
             )
 
     @property

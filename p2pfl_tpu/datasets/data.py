@@ -169,20 +169,47 @@ class CrossDeviceData:
         FedAvg weights and the weighted-sampling distribution."""
         return np.minimum(self.part.sizes(), self.shard_size)
 
-    def cohort_batch(self, client_ids: np.ndarray):
+    def cohort_sizes(self, client_ids: np.ndarray) -> np.ndarray:
+        """``client_sizes[client_ids]`` without the O(N) full-population
+        diff — O(k) per round via ``ClientPartition.take_sizes`` (the
+        streamed driver's weight lookup, round 20)."""
+        return np.minimum(self.part.take_sizes(client_ids),
+                          self.shard_size).astype(np.int32)
+
+    def cohort_buffers(self, k: int):
+        """Preallocated host buffers for a ``k``-client
+        ``cohort_batch(out=...)`` — the streamed driver's double
+        buffer: two of these per run bound the host-side cohort
+        residency at exactly two cohorts regardless of N or C."""
+        s = self.shard_size
+        return (np.zeros((k, s) + self.input_shape, np.float32),
+                np.zeros((k, s), np.int32),
+                np.zeros((k, s), bool),
+                np.zeros((k,), np.int32))
+
+    def cohort_batch(self, client_ids: np.ndarray, out=None):
         """Materialize the sampled clients' shards, padded to
         ``shard_size``: ``(x [k,S,...], y [k,S], mask [k,S],
         n_samples [k])``. Each client's rows are drawn through a
         per-client seeded shuffle before the cap — dirichlet partitions
         are label-grouped, and an unshuffled head slice would be
         single-label (the FederatedDataset.make guard, applied lazily).
+
+        ``out`` (round 20): an existing ``cohort_buffers(k)`` tuple to
+        fill in place instead of allocating — the values written are
+        identical either way, so streaming through reused buffers
+        cannot change round math.
         """
         k = len(client_ids)
         s = self.shard_size
-        x = np.zeros((k, s) + self.input_shape, np.float32)
-        y = np.zeros((k, s), np.int32)
-        mask = np.zeros((k, s), bool)
-        sizes = np.zeros((k,), np.int32)
+        if out is None:
+            x, y, mask, sizes = self.cohort_buffers(k)
+        else:
+            x, y, mask, sizes = out
+            x[:k] = 0.0
+            y[:k] = 0
+            mask[:k] = False
+            sizes[:k] = 0
         for j, cid in enumerate(client_ids):
             idx = self.part.client_indices(int(cid))
             rng = np.random.default_rng(self.seed * 100003 + int(cid))

@@ -226,6 +226,14 @@ class ClientPartition:
         weights for weighted K-of-N sampling."""
         return np.diff(self.offsets)
 
+    def take_sizes(self, client_ids: np.ndarray) -> np.ndarray:
+        """Shard sizes of just ``client_ids`` (any shape), O(k) —
+        the streamed round's per-cohort weight lookup (round 20). At
+        N=100k..1M a full ``sizes()`` diff every round would touch the
+        whole population to weight the K sampled clients."""
+        ids = np.asarray(client_ids, np.int64)
+        return self.offsets[ids + 1] - self.offsets[ids]
+
 
 def _partition_from_assignment(node_of: np.ndarray,
                                n_clients: int) -> ClientPartition:

@@ -66,3 +66,32 @@ def sample_clients(
             )
         p = w / total
     return rng.choice(n_clients, size=k, replace=False, p=p).astype(np.int64)
+
+
+def sample_cohorts(
+    n_clients: int,
+    clients_per_round: int,
+    cohort_size: int,
+    round_num: int,
+    seed: int = 0,
+    weights: np.ndarray | None = None,
+) -> tuple[np.ndarray, np.ndarray]:
+    """One round's draw already shaped for the cohort scan:
+    ``(sampled [K], cohorts [cohort_size, n_slots])`` row-major —
+    cohort step t runs clients ``sampled[t*n_slots:(t+1)*n_slots]``.
+
+    Centralizing the reshape (round 20) keeps every consumer — the
+    monolithic scan, the sharded chunk arms, and the streamed prefetch
+    driver, which materializes one cohort ROW at a time — on the
+    IDENTICAL client-to-slot assignment for a given ``(seed,
+    round_num)``. Prefetch-order determinism under resampling is a
+    property of this function, pinned by tests/test_cross_device.py.
+    """
+    if clients_per_round % cohort_size:
+        raise ValueError(
+            f"clients_per_round={clients_per_round} must be a multiple "
+            f"of cohort_size={cohort_size}")
+    sampled = sample_clients(n_clients, clients_per_round, round_num,
+                             seed=seed, weights=weights)
+    n_slots = clients_per_round // cohort_size
+    return sampled, sampled.reshape(cohort_size, n_slots)

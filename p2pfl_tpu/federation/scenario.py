@@ -44,20 +44,23 @@ from p2pfl_tpu.federation.checkpoint import (
 )
 from p2pfl_tpu.federation.events import Events, Observable
 from p2pfl_tpu.federation.membership import Membership
-from p2pfl_tpu.federation.sampling import sample_clients
+from p2pfl_tpu.federation.sampling import sample_clients, sample_cohorts
 from p2pfl_tpu.learning.learner import make_step_fns
 from p2pfl_tpu.models.base import build_model
 from p2pfl_tpu.parallel.federated import (
     FederatedState,
+    build_cross_device_stream_fns,
     build_eval_fn,
     build_round_fn,
     build_round_fn_cross_device,
     build_round_fn_sparse,
+    cross_device_wn,
     init_federation,
     make_round_plan,
     staleness_scale,
     with_staged_buffer,
 )
+from p2pfl_tpu.parallel.mesh import cohort_shard_mesh
 from p2pfl_tpu.obs import flight
 from p2pfl_tpu.obs import trace as obs_trace
 from p2pfl_tpu.parallel.transport import MeshTransport, edge_offsets
@@ -761,23 +764,67 @@ class CrossDeviceScenario(Observable):
             tensorboard=config.tensorboard,
             wandb=config.wandb and self._proc0,
         )
-        self.transport = MeshTransport(cd.n_slots)
+        # round-20 device scaling: with cohort_shards > 1 and enough
+        # devices, the round runs the shard_map arm over a cohort mesh;
+        # with too few devices it silently falls back to the chunked
+        # single-device arm. Chunk structure is part of the round's
+        # semantics, placement is not: within one device topology the
+        # arms are bit-identical (pinned by tests/test_cross_device.py),
+        # but a DIFFERENT topology (e.g. the fallback firing on a
+        # 1-device host) may fuse the training body differently and
+        # drift ~1 ulp — same reassociation caveat as perf.md §19.1.
+        # The slot transport is rebuilt over the SAME device set as the
+        # cohort mesh — one jit must not see two device orders.
+        self._cohort_mesh = None
+        if cd.cohort_shards > 1 and cd.cohort_shards <= jax.device_count():
+            self._cohort_mesh = cohort_shard_mesh(cd.cohort_shards)
+            self.transport = MeshTransport(cd.n_slots,
+                                           n_devices=cd.cohort_shards)
+        else:
+            self.transport = MeshTransport(cd.n_slots)
         self._exchange_dtype = (
             jnp.bfloat16 if config.wire_dtype in ("bf16", "int8") else None
         )
-        round_fn = build_round_fn_cross_device(
-            self.fns,
-            epochs=config.training.epochs_per_round,
-            exchange_dtype=self._exchange_dtype,
-            fused_accumulate=cd.accumulate == "fused",
-        )
-        self._round_fn = self.transport.compile_round(round_fn)
+        self._stream = cd.prefetch == "stream"
+        if self._stream:
+            # streamed arm (round 20): the round is driven step-by-step
+            # so cohort t+1's host gather + device_put overlaps cohort
+            # t's compute — see _run_streamed_round
+            init_carry, step_fn, finalize = build_cross_device_stream_fns(
+                self.fns,
+                epochs=config.training.epochs_per_round,
+                exchange_dtype=self._exchange_dtype,
+                fused_accumulate=cd.accumulate == "fused",
+            )
+            self._stream_init_carry = init_carry
+            self._stream_step = jax.jit(step_fn, donate_argnums=(1,))
+            self._stream_finalize = jax.jit(finalize)
+            self._wn_fn = jax.jit(cross_device_wn)
+            self._stream_bufs = None  # two cohort_buffers: the double buffer
+            self._round_fn = None
+        else:
+            round_fn = build_round_fn_cross_device(
+                self.fns,
+                epochs=config.training.epochs_per_round,
+                exchange_dtype=self._exchange_dtype,
+                fused_accumulate=cd.accumulate == "fused",
+                cohort_shards=cd.cohort_shards,
+                cohort_mesh=self._cohort_mesh,
+            )
+            self._round_fn = self.transport.compile_round(round_fn)
         self._eval_fn = self.transport.compile_eval(build_eval_fn(self.fns))
         sample_x = jnp.zeros((1,) + self.data.input_shape, jnp.float32)
-        self.fed = self.transport.put_stacked(
-            init_federation(self.fns, sample_x, cd.n_slots,
-                            seed=config.seed)
-        )
+        fed0 = init_federation(self.fns, sample_x, cd.n_slots,
+                               seed=config.seed)
+        # the mesh arm replicates the federation state (every device
+        # scans ALL slots for its chunk); otherwise the slot axis
+        # shards as before
+        self.fed = (self.transport.put_replicated(fed0)
+                    if self._cohort_mesh is not None
+                    else self.transport.put_stacked(fed0))
+        # live gauges for the monitor/launch status plumbing (round 20):
+        # refreshed per round, splatted into status records
+        self.crossdev_last: dict[str, Any] = {}
         self._x_test = self.transport.put_replicated(
             jnp.asarray(self.data.x_test))
         self._y_test = self.transport.put_replicated(
@@ -795,6 +842,98 @@ class CrossDeviceScenario(Observable):
         t = (self.membership.clock
              + self.membership.protocol.heartbeat_period_s)
         return self.membership.advance_to(t)
+
+    def _run_streamed_round(self, cohorts: np.ndarray,
+                            c_alive: np.ndarray) -> dict[str, Any]:
+        """One round through the double-buffered prefetch seam (round
+        18): while the device runs cohort step t, the host gathers
+        cohort t+1's shards into the OTHER of two reused host buffers
+        and ``device_put``s them — at most two cohorts of client data
+        resident (host or device) at any instant, for any N. The steps
+        run the same ``_cross_device_body`` as the monolithic scan in
+        the same order with the same globally-normalized weights, so a
+        streamed round is bit-identical to ``prefetch="off"``.
+
+        Gauges recorded into ``crossdev_last``:
+        ``crossdev_prefetch_mb`` — host→device bytes shipped this
+        round; ``crossdev_prefetch_stall_s`` — wall time blocked on
+        gather + transfer completion (an upper bound on the stall the
+        prefetch failed to hide; the gather itself runs while the
+        device computes)."""
+        cd = self.cd
+        data = self.data
+        c = cd.cohort_size
+        if self._stream_bufs is None:
+            self._stream_bufs = (data.cohort_buffers(cd.n_slots),
+                                 data.cohort_buffers(cd.n_slots))
+        # FedAvg weights need sizes only — host metadata, no client data
+        sizes = data.cohort_sizes(cohorts)
+        wn, got_any = self._wn_fn(jnp.asarray(sizes),
+                                  jnp.asarray(c_alive))
+        alive_dev = self.transport.put_replicated(jnp.asarray(c_alive))
+        prefetch_bytes = 0
+        stall_s = 0.0
+        sh = self.transport.replicated
+
+        def gather_put(t):
+            nonlocal prefetch_bytes, stall_s
+            t0 = time.monotonic()
+            x, y, m, _ = data.cohort_batch(cohorts[t],
+                                           out=self._stream_bufs[t % 2])
+            # the sanctioned per-round-loop device_put: THE prefetch
+            # seam (everywhere else fedlint's recompile-hazard rule
+            # flags puts inside round loops)
+            dev = tuple(
+                jax.device_put(a, sh)  # fedlint: disable=recompile-hazard
+                for a in (x, y, m)
+            )
+            # wait for the DMA (not the compute) before the host buffer
+            # may be rewritten two steps from now
+            jax.block_until_ready(dev)
+            stall_s += time.monotonic() - t0
+            return dev
+
+        buf = gather_put(0)
+        prefetch_bytes = sum(a.nbytes for a in buf) * c
+        params0 = self.fed.states.params
+        carry = jax.tree.map(jnp.copy, self._stream_init_carry(self.fed))
+        losses = []
+        for t in range(c):
+            x_t, y_t, m_t = buf
+            # async dispatch: the host returns before the step finishes,
+            # so the next gather below overlaps this step's compute
+            carry, loss_t = self._stream_step(
+                params0, carry, x_t, y_t, m_t, alive_dev[t], wn[t])
+            if t + 1 < c:
+                buf = gather_put(t + 1)
+            losses.append(loss_t)
+        self.fed = self._stream_finalize(self.fed, carry, got_any)
+        self.crossdev_last["crossdev_prefetch_mb"] = round(
+            prefetch_bytes / 1e6, 2)
+        self.crossdev_last["crossdev_prefetch_stall_s"] = round(
+            stall_s, 4)
+        return {
+            "train_loss": np.stack([np.asarray(l) for l in losses]),
+            "alive": self.fed.alive,
+        }
+
+    def _publish_crossdev_status(self, r: int, mean_loss: float) -> None:
+        """One status record for the whole cross-device driver (there
+        are no per-node processes to speak for themselves) — the
+        monitor/webapp throughput pane reads the crossdev_* gauges."""
+        if self.logger.dir is None:
+            return
+        publish_status(
+            self.logger.dir / "status", 0,
+            {
+                "role": "crossdev",
+                "round": r + 1,
+                "loss": mean_loss,
+                "peers": self.cd.n_slots - 1,
+                "recompiles": obs_trace.xla_recompiles(),
+                **self.crossdev_last,
+            },
+        )
 
     def evaluate(self) -> dict[str, Any]:
         """Central-test-set quality of the global model. Every slot
@@ -826,25 +965,28 @@ class CrossDeviceScenario(Observable):
             t0 = time.monotonic()
             self.notify(Events.ROUND_STARTED, {"round": r})
             alive = self._advance_membership(r)
-            sampled = sample_clients(
-                cd.n_clients, cd.clients_per_round, r, seed=cd.seed,
-                weights=self._sample_weights,
+            # row-major cohorts: cohort step t runs clients
+            # sampled[t*n_slots:(t+1)*n_slots] (sample_cohorts pins the
+            # assignment shared by every arm)
+            sampled, cohorts = sample_cohorts(
+                cd.n_clients, cd.clients_per_round, cd.cohort_size, r,
+                seed=cd.seed, weights=self._sample_weights,
             )
-            # row-major reshape: cohort step t runs clients
-            # sampled[t*n_slots:(t+1)*n_slots]
-            cohorts = sampled.reshape(cd.cohort_size, cd.n_slots)
             c_alive = alive[cohorts]
-            x, y, mask, sizes = self.data.cohort_batch(sampled)
-            shape2 = (cd.cohort_size, cd.n_slots)
-            # leading axis is the SCAN axis (cohort_size), not the slot
-            # axis — replicate; the per-slot split happens inside the
-            # compiled round
-            args = tuple(
-                tr.put_replicated(jnp.asarray(a.reshape(
-                    shape2 + a.shape[1:])))
-                for a in (x, y, mask, sizes)
-            ) + (tr.put_replicated(jnp.asarray(c_alive)),)
-            self.fed, metrics = self._round_fn(self.fed, *args)
+            if self._stream:
+                metrics = self._run_streamed_round(cohorts, c_alive)
+            else:
+                x, y, mask, sizes = self.data.cohort_batch(sampled)
+                shape2 = (cd.cohort_size, cd.n_slots)
+                # leading axis is the SCAN axis (cohort_size), not the
+                # slot axis — replicate; the per-slot split happens
+                # inside the compiled round
+                args = tuple(
+                    tr.put_replicated(jnp.asarray(a.reshape(
+                        shape2 + a.shape[1:])))
+                    for a in (x, y, mask, sizes)
+                ) + (tr.put_replicated(jnp.asarray(c_alive)),)
+                self.fed, metrics = self._round_fn(self.fed, *args)
             jax.block_until_ready(self.fed.states.params)
             dt = time.monotonic() - t0
             round_times.append(dt)
@@ -856,6 +998,12 @@ class CrossDeviceScenario(Observable):
             losses = np.asarray(metrics["train_loss"]).astype(np.float64)
             live = c_alive.astype(bool)
             mean_loss = float(losses[live].mean()) if live.any() else 0.0
+            # live throughput gauges (round 20): the monitor's cl/s and
+            # prefetch columns; prefetch keys exist only on streamed
+            # rounds (renderers show "-" when absent)
+            self.crossdev_last["crossdev_clients_per_s"] = round(
+                len(sampled) / dt, 2) if dt > 0 else None
+            self._publish_crossdev_status(r, mean_loss)
             self.logger.log_metrics(
                 {"Train/loss": mean_loss,
                  "Train/round_time_s": dt,

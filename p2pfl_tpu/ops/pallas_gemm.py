@@ -74,6 +74,7 @@ __all__ = [
     "dense_matmul",
     "conv2_matmul",
     "sgd_accum",
+    "fedavg_accum",
     "stream_gemm",
     "stream_wgrad",
     "dense_bwd",
@@ -460,6 +461,30 @@ def sgd_accum(p, m, g, lr_gate, *, momentum: float,
                                      float(momentum), int(block_m), itp)
     return (p_new.reshape(shape), m_new.reshape(m.shape),
             acc_new.reshape(acc.shape))
+
+
+def fedavg_accum(p, acc, weight, block_m: int = _BLOCK_M,
+                 interpret: bool | None = None):
+    """FedAvg accumulate as a *null* ``sgd_accum`` step (round 20):
+    ``acc_new = acc + weight * p`` (f32) in one streaming pass, sharing
+    the ``_sgd_accum_kernel`` the learner's fused optimizer uses — and
+    therefore the same measured ``choose("sgd_accum", ...)`` decision.
+
+    The optimizer half runs with ``g = 0``, ``momentum = 0``,
+    ``lr_gate = 0``: ``m_new = 0``, ``p_new = (p + 0 * -0).astype(
+    p.dtype) = p`` — the param stream passes through untouched (the
+    ``+0.0`` can at most flip a ``-0.0`` to ``+0.0``, inert inside the
+    weighted sum), so only the accumulate line does work. This is how
+    the cross-device round's fit-epilogue accumulate
+    (``parallel/federated.py``) rides the kernel without a second
+    kernel body to parity-test. ``acc`` must match ``p``'s streamed 2-D
+    shape ``[prod(shape[:-1]), shape[-1]]``. Returns ``acc_new`` only.
+    """
+    z = jnp.zeros_like(p)
+    _, _, acc_new = sgd_accum(p, z, z, 0.0, momentum=0.0, acc=acc,
+                              weight=weight, block_m=block_m,
+                              interpret=interpret)
+    return acc_new
 
 
 # ---------------------------------------------------------------------------

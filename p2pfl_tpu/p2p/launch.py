@@ -158,6 +158,19 @@ def _critpath_status(node) -> dict:
     }
 
 
+def _crossdev_status(obj) -> dict:
+    """Cross-device throughput gauges (round 20) for a status record:
+    ``crossdev_clients_per_s`` plus, on streamed rounds, the prefetch
+    bytes/stall pair. Reads the driver's ``crossdev_last`` dict
+    (CrossDeviceScenario refreshes it per round); empty — and therefore
+    rendered as "-" by the monitor — for anything that is not a
+    cross-device driver."""
+    last = getattr(obj, "crossdev_last", None)
+    if not last:
+        return {}
+    return dict(last)
+
+
 def _free_ports(n: int) -> list[int]:
     socks, ports = [], []
     for _ in range(n):
@@ -282,6 +295,7 @@ async def _run_node(cfg: ScenarioConfig, idx: int, ports: list[int],
                      "peer_bytes_out": dict(node.peer_bytes_out),
                      "recompiles": obs_trace.xla_recompiles(),
                      **_critpath_status(node),
+                     **_crossdev_status(learner),
                      **_aggd_status(sidecar)},
                 )
                 await asyncio.sleep(cfg.protocol.heartbeat_period_s)
@@ -552,6 +566,7 @@ async def _simulate(cfg: ScenarioConfig, timeout: float = 600) -> dict:
                      "peer_bytes_out": dict(nd.peer_bytes_out),
                      "recompiles": obs_trace.xla_recompiles(),
                      **_critpath_status(nd),
+                     **_crossdev_status(nd),
                      **_aggd_status(sidecar)},
                 )
 

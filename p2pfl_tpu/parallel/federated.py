@@ -587,11 +587,163 @@ def build_round_fn_sparse(
     return sharded
 
 
+def cross_device_wn(c_sizes, c_alive):
+    """Globally normalized FedAvg weights over ALL ``C x n_slots``
+    sampled clients, plus the empty-round flag. Shared by the monolithic
+    scan, both sharded arms, and the streamed driver so the weighting —
+    and therefore the aggregate — cannot drift between them."""
+    w = c_sizes.astype(jnp.float32) * c_alive  # [C, n_slots]
+    denom = jnp.maximum(jnp.sum(w), 1e-9)
+    return w / denom, jnp.sum(w) > 0
+
+
+def _cross_device_plan(params0, fused_accumulate: bool):
+    """Per-leaf route for the fit-epilogue accumulate: ``True`` sends
+    the leaf through the fused ``pallas_gemm.fedavg_accum`` stream,
+    ``False`` keeps the exact-XLA gemm-row contraction. The key is the
+    learner's ``_fused_sgd_step`` key verbatim (same per-slot 2-D
+    shape, same ``sgd_accum`` kind, same nodes hint), so one measured
+    decision covers both call sites. Off-TPU the gate forces xla
+    (unless the ``P2PFL_PALLAS_GEMM`` env knob forces pallas — the
+    interpret-mode parity-test route), so tier-1 numerics are
+    unchanged. Plan is all-False for the unfused layout: the reference
+    arm stays the reference."""
+    from p2pfl_tpu.ops import pallas_gemm
+
+    def leaf_plan(p):
+        if not fused_accumulate or p.ndim < 2:
+            return False  # per-slot scalar: nothing to stream
+        leaf = p.shape[1:]
+        rows = int(np.prod(leaf[:-1], dtype=np.int64)) if len(leaf) > 1 else 1
+        shape2 = (rows, int(leaf[-1]))
+        return pallas_gemm.choose(
+            "sgd_accum", (shape2, shape2), p.dtype) == "pallas"
+
+    return jax.tree.map(leaf_plan, params0)
+
+
+def _cross_device_acc0(params0, fused_accumulate: bool, plan):
+    """Zero accumulators, one per leaf, in the layout the route wants:
+    pallas leaves carry a per-slot 2-D stream ``[n_slots, rows, cols]``
+    (summed over slots once at round end), fused-gemm leaves ONE flat
+    f32 row ``[1, d]``, unfused leaves the full ``[n_slots, d]``."""
+
+    def leaf0(p, use_pallas):
+        if use_pallas:
+            leaf = p.shape[1:]
+            rows = (int(np.prod(leaf[:-1], dtype=np.int64))
+                    if len(leaf) > 1 else 1)
+            return jnp.zeros((p.shape[0], rows, int(leaf[-1])),
+                             jnp.float32)
+        rows = 1 if fused_accumulate else p.shape[0]
+        return jnp.zeros(
+            (rows, int(np.prod(p.shape[1:], dtype=np.int64))),
+            jnp.float32)
+
+    return jax.tree.map(leaf0, params0, plan)
+
+
+def _cross_device_body(fns: StepFns, epochs: int, mix_dt,
+                       fused_accumulate: bool, params0, n_slots: int,
+                       plan) -> Callable:
+    """One cohort step of the cross-device scan: train the cohort from
+    the round-start ``params0``, fold its weighted contribution into
+    the accumulator. THE body — the monolithic scan, both sharded
+    arms, and the streamed driver all run exactly this function, which
+    is what makes their per-step values bit-identical by construction
+    rather than by test luck."""
+    trains = jnp.ones((n_slots,), bool)
+    from p2pfl_tpu.ops.pallas_gemm import fedavg_accum
+
+    def body(carry, inputs):
+        opt_state, rng, step, acc = carry
+        x_t, y_t, m_t, alive_t, wn_t = inputs
+        states_t = TrainState(
+            params=params0, opt_state=opt_state, rng=rng, step=step
+        )
+        states_t, tm = _train_and_select(
+            fns, states_t, alive_t, trains, x_t, y_t, m_t, epochs
+        )
+
+        # hoisted out of the leaf loop: one weight operand per step,
+        # not one broadcast+cast per leaf
+        w_t = jnp.broadcast_to(
+            wn_t[None, :], (n_slots, n_slots)
+        ).astype(mix_dt)
+
+        def leaf_acc(a, p, use_pallas):
+            if use_pallas:
+                # per-slot fused stream: acc[s] += wn[s] * p[s] in one
+                # pass through the sgd_accum kernel (null optimizer
+                # half); the slot axis collapses once at round end
+                return jax.vmap(
+                    lambda ai, pi, wi: fedavg_accum(
+                        pi.reshape(ai.shape).astype(mix_dt), ai, wi)
+                )(a, p, wn_t)
+            flat = p.reshape(p.shape[0], -1).astype(mix_dt)
+            partial = jax.lax.dot(
+                w_t, flat,
+                preferred_element_type=jnp.float32,
+            )
+            if fused_accumulate:
+                # the barrier pins the gemm before the row slice —
+                # without it XLA may turn slice-of-dot into a gemv
+                # whose reduction order is 1 ulp off the gemm row,
+                # breaking the tolerance-0 parity gates
+                partial = jax.lax.optimization_barrier(partial)[0:1]
+            return a + partial
+
+        acc = jax.tree.map(leaf_acc, acc, states_t.params, plan)
+        carry = (states_t.opt_state, states_t.rng, states_t.step, acc)
+        return carry, tm["loss"]
+
+    return body
+
+
+def _cross_device_leaf_out(keep, n_slots: int, fused_accumulate: bool):
+    """Round-end epilogue per leaf: collapse the accumulator back to
+    the ``[n_slots, ...]`` param stack, keeping the old params where
+    the round was empty or the slot dead."""
+
+    def leaf_out(a, p, use_pallas):
+        if use_pallas:
+            # per-slot partials [n_slots, rows, cols]: the slot sum IS
+            # the sum over all C x n_slots clients (weights were
+            # globally normalized up front)
+            row = a.sum(axis=0).reshape((1,) + p.shape[1:]).astype(p.dtype)
+            out = jnp.broadcast_to(row, p.shape)
+        elif fused_accumulate:
+            row = a.reshape((1,) + p.shape[1:]).astype(p.dtype)
+            out = jnp.broadcast_to(row, p.shape)
+        else:
+            out = a.reshape(p.shape).astype(p.dtype)
+        c = keep.reshape((n_slots,) + (1,) * (p.ndim - 1))
+        return jnp.where(c, out, p)
+
+    return leaf_out
+
+
+def _ordered_chunk_sum(stacked, n_chunks: int):
+    """Sum a ``[D, ...]`` stack of per-chunk partials chunk 0 first —
+    an unrolled, order-pinned add chain, identical code whether the
+    stack came off the shard_map or the single-device chunk scan. This
+    is the deterministic re-association of the cross-chunk psum: by
+    doing the reduce OUTSIDE the mapped region in a fixed order, the
+    sharded and single-device arms produce bit-identical sums instead
+    of collective-implementation-defined ones."""
+    total = stacked[0]
+    for i in range(1, n_chunks):
+        total = total + stacked[i]
+    return total
+
+
 def build_round_fn_cross_device(
     fns: StepFns,
     epochs: int = 1,
     exchange_dtype: Any | None = None,
     fused_accumulate: bool = True,
+    cohort_shards: int = 1,
+    cohort_mesh: Any | None = None,
 ) -> Callable:
     """The cross-device round (round 13): one compiled program runs a
     ``lax.scan`` over stacked cohorts, so an ``n_slots``-wide mesh
@@ -649,86 +801,142 @@ def build_round_fn_cross_device(
     All shapes are fixed by ``(n_slots, C, shard_size)`` — resampling
     clients each round never recompiles (the crossdev_xla_recompiles
     bench key pins this, for both layouts).
+
+    **Sharded cohort scan (round 20).** ``cohort_shards = D > 1``
+    splits the C cohort steps into D contiguous chunks; each chunk
+    scans from the SAME round-start carry (params0, opt_state0, rng0,
+    step0, zero accumulator), so chunks are independent and can run on
+    D devices at once. The chunk structure is part of the round's
+    *semantics*, not a layout detail: the final opt_state/rng/step come
+    from the LAST chunk, and the D per-chunk accumulator partials are
+    reduced by an order-pinned unrolled add chain (chunk 0 first) —
+    the cross-chunk psum deterministically re-associated OUTSIDE the
+    mapped region. Both arms — ``cohort_mesh=None`` (an outer
+    ``lax.scan`` over chunks on one device) and a ``cohort_shard_mesh``
+    (``shard_map`` over the ``cohorts`` axis) — run the identical body
+    and the identical reduce, which is what makes sharded vs
+    single-device bit-for-bit (params AND opt_state, tolerance 0) at
+    the same ``cohort_shards``, with zero post-warm-up recompiles on
+    either arm. ``D = 1`` degenerates to exactly the monolithic scan
+    above — the round-13/17 gates are untouched. Requires
+    ``C % cohort_shards == 0``.
+
+    **Fused accumulate route (round 20, closing the round-17 loose
+    end).** Per leaf, the measured ``pallas_gemm.choose("sgd_accum")``
+    gate may route the fit-epilogue accumulate through
+    ``fedavg_accum`` — the learner's fused SGD+accumulate kernel with
+    the optimizer half nulled — as a per-slot streaming
+    ``acc[s] += wn[s] * p[s]`` whose slot axis collapses once at round
+    end. Exact-XLA gemm fallback per leaf; CPU resolves xla so tier-1
+    numerics are bit-unchanged. The pallas route re-associates the
+    ``[n,n]@[n,d]`` contraction (sum over slots then steps), so its
+    parity vs the gemm path is allclose, not tolerance-0 — pinned by
+    tests/test_cross_device.py with the env knob forcing both ways.
     """
+    mix_dt = exchange_dtype or jnp.float32
+    if cohort_shards < 1:
+        raise ValueError(f"cohort_shards must be >= 1, got {cohort_shards}")
+    if cohort_mesh is not None and cohort_mesh.size != cohort_shards:
+        raise ValueError(
+            f"cohort_mesh has {cohort_mesh.size} devices but "
+            f"cohort_shards={cohort_shards}")
 
     def round_fn(fed: FederatedState, cx, cy, cmask, c_sizes, c_alive):
         n_slots = fed.alive.shape[0]
         params0 = fed.states.params  # round-start global model
-        trains = jnp.ones((n_slots,), bool)
-        mix_dt = exchange_dtype or jnp.float32
 
         # FedAvg weights over ALL C x n_slots sampled clients,
         # normalized once — the per-step dots then just accumulate
-        w = c_sizes.astype(jnp.float32) * c_alive  # [C, n_slots]
-        denom = jnp.maximum(jnp.sum(w), 1e-9)
-        wn = w / denom
-        got_any = jnp.sum(w) > 0
+        wn, got_any = cross_device_wn(c_sizes, c_alive)
 
-        acc_rows = 1 if fused_accumulate else None
-        acc0 = jax.tree.map(
-            lambda p: jnp.zeros(
-                (acc_rows or p.shape[0],
-                 int(np.prod(p.shape[1:], dtype=np.int64))),
-                jnp.float32,
-            ),
-            params0,
-        )
+        plan = _cross_device_plan(params0, fused_accumulate)
+        acc0 = _cross_device_acc0(params0, fused_accumulate, plan)
         carry0 = (fed.states.opt_state, fed.states.rng, fed.states.step,
                   acc0)
+        body = _cross_device_body(fns, epochs, mix_dt, fused_accumulate,
+                                  params0, n_slots, plan)
 
-        def body(carry, inputs):
+        n_cohorts = cx.shape[0]
+        if cohort_shards == 1:
+            carry, losses = jax.lax.scan(
+                body, carry0, (cx, cy, cmask, c_alive, wn)
+            )
             opt_state, rng, step, acc = carry
-            x_t, y_t, m_t, alive_t, wn_t = inputs
-            states_t = TrainState(
-                params=params0, opt_state=opt_state, rng=rng, step=step
+        else:
+            d = cohort_shards
+            if n_cohorts % d != 0:
+                raise ValueError(
+                    f"cohort_size {n_cohorts} not divisible by "
+                    f"cohort_shards {d}")
+            chunked = jax.tree.map(
+                lambda a: a.reshape((d, n_cohorts // d) + a.shape[1:]),
+                (cx, cy, cmask, c_alive, wn),
             )
-            states_t, tm = _train_and_select(
-                fns, states_t, alive_t, trains, x_t, y_t, m_t, epochs
-            )
+            if cohort_mesh is None:
+                # single-device arm: the chunks run sequentially from
+                # the SAME chunk-local carries as the mesh arm. The
+                # loop is Python-unrolled rather than an outer
+                # lax.scan: nesting the chunk scan inside a while
+                # loop changes how XLA fuses the training body and
+                # drifts ~1 ulp from the shard_map program; unrolled,
+                # each chunk compiles at top level exactly like one
+                # device's shard_map shard, and the arms are
+                # bit-identical (d is a small static constant)
+                outs = []
+                for i in range(d):
+                    chunk_i = jax.tree.map(lambda a, i=i: a[i],
+                                           chunked)
+                    carry, losses_c = jax.lax.scan(
+                        body, carry0, chunk_i)
+                    outs.append((jax.tree.map(lambda t: t[None],
+                                              carry),
+                                 losses_c[None]))
+                carries, losses_d = jax.tree.map(
+                    lambda *xs: jnp.concatenate(xs, axis=0), *outs)
+            else:
+                from p2pfl_tpu.parallel.mesh import (
+                    COHORTS_AXIS, shard_map_compat)
+                from jax.sharding import PartitionSpec
 
-            # hoisted out of the leaf loop: one weight operand per step,
-            # not one broadcast+cast per leaf
-            w_t = jnp.broadcast_to(
-                wn_t[None, :], (n_slots, n_slots)
-            ).astype(mix_dt)
+                Pc = PartitionSpec(COHORTS_AXIS)
+                Pr = PartitionSpec()
 
-            def leaf_acc(a, p):
-                flat = p.reshape(p.shape[0], -1).astype(mix_dt)
-                partial = jax.lax.dot(
-                    w_t, flat,
-                    preferred_element_type=jnp.float32,
+                # params0/carry0 are passed explicitly (replicated):
+                # shard_map must not close over tracers
+                def shard_body(params0_, carry0_, x_c, y_c, m_c, a_c,
+                               w_c):
+                    body_ = _cross_device_body(
+                        fns, epochs, mix_dt, fused_accumulate,
+                        params0_, n_slots, plan)
+                    # local view: one chunk with a leading axis of 1
+                    carry, losses_c = jax.lax.scan(
+                        body_, carry0_,
+                        (x_c[0], y_c[0], m_c[0], a_c[0], w_c[0]))
+                    return (jax.tree.map(lambda t: t[None], carry),
+                            losses_c[None])
+
+                sharded = shard_map_compat(
+                    shard_body,
+                    mesh=cohort_mesh,
+                    in_specs=(Pr, Pr, Pc, Pc, Pc, Pc, Pc),
+                    out_specs=(Pc, Pc),
                 )
-                if fused_accumulate:
-                    # the barrier pins the gemm before the row slice —
-                    # without it XLA may turn slice-of-dot into a gemv
-                    # whose reduction order is 1 ulp off the gemm row,
-                    # breaking the tolerance-0 parity gates
-                    partial = jax.lax.optimization_barrier(partial)[0:1]
-                return a + partial
-
-            acc = jax.tree.map(leaf_acc, acc, states_t.params)
-            carry = (states_t.opt_state, states_t.rng, states_t.step,
-                     acc)
-            return carry, tm["loss"]
-
-        (opt_state, rng, step, acc), losses = jax.lax.scan(
-            body, carry0, (cx, cy, cmask, c_alive, wn)
-        )
+                carries, losses_d = sharded(params0, carry0, *chunked)
+            # finals from the LAST chunk; accumulator partials reduced
+            # chunk 0 first — the order-pinned psum re-association
+            opt_state = jax.tree.map(lambda t: t[-1], carries[0])
+            rng = carries[1][-1]
+            step = carries[2][-1]
+            acc = jax.tree.map(lambda s: _ordered_chunk_sum(s, d),
+                               carries[3])
+            losses = losses_d.reshape((n_cohorts,) + losses_d.shape[2:])
 
         # an empty round (every sampled client dead) keeps the global
         # model — the cross-device analog of the dense got_any keep
         keep = jnp.logical_and(fed.alive, got_any)
-
-        def leaf_out(a, p):
-            if fused_accumulate:
-                row = a.reshape((1,) + p.shape[1:]).astype(p.dtype)
-                out = jnp.broadcast_to(row, p.shape)
-            else:
-                out = a.reshape(p.shape).astype(p.dtype)
-            c = keep.reshape((n_slots,) + (1,) * (p.ndim - 1))
-            return jnp.where(c, out, p)
-
-        params = jax.tree.map(leaf_out, acc, params0)
+        leaf_out = _cross_device_leaf_out(keep, n_slots,
+                                          fused_accumulate)
+        params = jax.tree.map(leaf_out, acc, params0, plan)
         fed = FederatedState(
             states=TrainState(
                 params=params, opt_state=opt_state, rng=rng, step=step
@@ -744,6 +952,69 @@ def build_round_fn_cross_device(
         return fed, metrics
 
     return round_fn
+
+
+def build_cross_device_stream_fns(
+    fns: StepFns,
+    epochs: int = 1,
+    exchange_dtype: Any | None = None,
+    fused_accumulate: bool = True,
+) -> tuple[Callable, Callable, Callable]:
+    """The cross-device round unrolled for streamed client state
+    (round 20): ``(init_carry, step, finalize)`` instead of one scan
+    over pre-materialized cohorts, so the host can gather and
+    ``device_put`` cohort t+1 while the device trains cohort t
+    (``CrossDeviceScenario``'s double-buffered prefetch seam) — an
+    N=100k..1M round materializes TWO cohorts of client data at any
+    instant instead of all C.
+
+    ``step(params0, carry, x_t, y_t, m_t, alive_t, wn_t)`` is exactly
+    one ``_cross_device_body`` step — the SAME body the monolithic scan
+    runs — so a streamed round is bit-identical to
+    ``build_round_fn_cross_device`` at ``cohort_shards=1`` with the
+    same cohort assignment. ``wn_t`` rows come from
+    ``cross_device_wn`` over the full ``[C, n_slots]`` sizes/alive
+    (client sizes need no client data — ``CrossDeviceData.
+    client_sizes`` is host metadata), computed once at round start.
+    ``finalize(fed, carry, got_any)`` runs the keep/where epilogue and
+    advances the round counter. The caller jits ``step`` once
+    (``donate_argnums`` the carry) and calls it C times per round —
+    fixed shapes, zero recompiles after warm-up.
+    """
+    mix_dt = exchange_dtype or jnp.float32
+
+    def init_carry(fed: FederatedState):
+        plan = _cross_device_plan(fed.states.params, fused_accumulate)
+        acc0 = _cross_device_acc0(fed.states.params, fused_accumulate,
+                                  plan)
+        return (fed.states.opt_state, fed.states.rng, fed.states.step,
+                acc0)
+
+    def step(params0, carry, x_t, y_t, m_t, alive_t, wn_t):
+        n_slots = alive_t.shape[0]
+        plan = _cross_device_plan(params0, fused_accumulate)
+        body = _cross_device_body(fns, epochs, mix_dt, fused_accumulate,
+                                  params0, n_slots, plan)
+        return body(carry, (x_t, y_t, m_t, alive_t, wn_t))
+
+    def finalize(fed: FederatedState, carry, got_any):
+        opt_state, rng, step_, acc = carry
+        n_slots = fed.alive.shape[0]
+        plan = _cross_device_plan(fed.states.params, fused_accumulate)
+        keep = jnp.logical_and(fed.alive, got_any)
+        leaf_out = _cross_device_leaf_out(keep, n_slots,
+                                          fused_accumulate)
+        params = jax.tree.map(leaf_out, acc, fed.states.params, plan)
+        return FederatedState(
+            states=TrainState(
+                params=params, opt_state=opt_state, rng=rng, step=step_
+            ),
+            alive=fed.alive,
+            round=fed.round + 1,
+            stale=fed.stale,
+        )
+
+    return init_carry, step, finalize
 
 
 def build_eval_fn(fns: StepFns) -> Callable:

@@ -18,6 +18,30 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 NODES_AXIS = "nodes"
 
+#: the cross-device round's second placement axis (round 20): cohort
+#: CHUNKS, not nodes. The cohort scan's C steps split into D
+#: contiguous chunks, one per device; each device scans its chunk from
+#: the same round-start params. Deliberately a separate 1-D mesh from
+#: ``federation_mesh`` — the cross-device plane has no persistent node
+#: axis to shard (slots are transient), so the whole mesh goes to the
+#: cohort axis.
+COHORTS_AXIS = "cohorts"
+
+
+def cohort_shard_mesh(n_devices: int,
+                      devices: list | None = None) -> Mesh:
+    """A 1-D ``cohorts`` mesh over ``n_devices`` for the sharded
+    cross-device scan (``build_round_fn_cross_device`` with
+    ``cohort_shards > 1``)."""
+    if devices is None:
+        devices = jax.devices()
+        if n_devices > len(devices):
+            raise ValueError(
+                f"asked for {n_devices} cohort-shard devices, "
+                f"have {len(devices)}")
+        devices = devices[:n_devices]
+    return Mesh(np.asarray(devices), (COHORTS_AXIS,))
+
 
 def federation_mesh(n_devices: int | None = None,
                     devices: list | None = None) -> Mesh:

@@ -190,6 +190,13 @@ class MeshTransport:
 
         return jax.tree.map(place, tree)
 
+    @property
+    def replicated(self):
+        """The mesh-replicated sharding, for callers that place buffers
+        with a raw ``jax.device_put`` (the cross-device streamed
+        prefetch seam) and must land on the transport's device set."""
+        return self._replicated
+
     def put_replicated(self, tree):
         return jax.tree.map(
             lambda x: self._place(x, self._replicated), tree

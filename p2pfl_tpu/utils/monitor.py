@@ -72,7 +72,8 @@ def read_statuses(directory: str | pathlib.Path) -> list[dict[str, Any]]:
 
 
 _COLUMNS = ("node", "role", "round", "loss", "accuracy", "trust",
-            "peers", "p95s", "wait%", "io_mb", "age", "health")
+            "peers", "p95s", "wait%", "cl/s", "pf", "io_mb", "age",
+            "health")
 
 
 def _health_cell(node: int | None, alerts) -> str:
@@ -97,6 +98,25 @@ def _wait_cell(rec: dict[str, Any]) -> str:
     if wait is None or not wall:
         return "-"
     return f"{100.0 * float(wait) / float(wall):.0f}%"
+
+
+def _clients_cell(rec: dict[str, Any]) -> str:
+    """CL/S cell: the cross-device driver's simulated clients per
+    second (``crossdev_clients_per_s``, the HEADLINE throughput) — "-"
+    for per-node planes, which have no cohort scan."""
+    v = rec.get("crossdev_clients_per_s")
+    return "-" if v is None else f"{float(v):.0f}"
+
+
+def _prefetch_cell(rec: dict[str, Any]) -> str:
+    """PF cell: streamed-round host→device prefetch traffic and stall,
+    ``<MB>/<stall s>`` — "-" off the streamed path (including plain
+    cross-device runs, which materialize cohorts up front)."""
+    mb = rec.get("crossdev_prefetch_mb")
+    st = rec.get("crossdev_prefetch_stall_s")
+    if mb is None and st is None:
+        return "-"
+    return f"{float(mb or 0):.0f}M/{float(st or 0):.2f}s"
 
 
 def _row(rec: dict[str, Any], now: float, liveness_s: float,
@@ -130,6 +150,10 @@ def _row(rec: dict[str, Any], now: float, liveness_s: float,
         # on quorum/barrier (critpath_wait_s / critpath_round_s). "-"
         # until the node closes a round with tracing-era gauges.
         "wait%": _wait_cell(rec),
+        # round-20 cross-device throughput plane: clients/s from the
+        # cohort-scan driver, prefetch MB/stall from streamed rounds.
+        "cl/s": _clients_cell(rec),
+        "pf": _prefetch_cell(rec),
         "io_mb": (
             "-" if bi is None and bo is None
             else f"{(bi or 0) / 1e6:.1f}/{(bo or 0) / 1e6:.1f}"

@@ -48,12 +48,32 @@ HEADLINE: dict[str, str] = {
     "cpu8_ring_dense_round_s": "lower",
     "crossdev_round_s_10k": "lower",
     "crossdev_clients_per_s": "higher",
+    # round 20: the sharded-scan mechanism gate — even where sharding
+    # is an honest negative (fake host devices), a regression here
+    # means the shard_map path itself got slower
+    "crossdev_sharded_round_s": "lower",
     "chaos_recovery_s": "lower",
     "chaos_final_accuracy": "higher",
     "aggd_round_s_24node_uncapped": "lower",
     "lora_payload_reduction": "higher",
 }
 DEFAULT_TOL = 0.15
+
+
+def _provenance(parsed: dict) -> tuple[str, int]:
+    """``(backend, device_count)`` of one parsed envelope. Rows
+    predating the round-20 stamps default to ``("cpu", 1)`` — every
+    checked-in trajectory row before the stamps existed was a 1-device
+    CPU dev-box run, so the default matches reality instead of
+    vacuuming legacy history out of the baseline."""
+    meta = parsed.get("meta")
+    meta = meta if isinstance(meta, dict) else {}
+    backend = meta.get("backend") or "cpu"
+    try:
+        devices = int(meta.get("device_count") or 1)
+    except (TypeError, ValueError):
+        devices = 1
+    return (str(backend), devices)
 
 
 def load_parsed(path: pathlib.Path) -> dict | None:
@@ -77,8 +97,15 @@ def load_parsed(path: pathlib.Path) -> dict | None:
 
 
 def baseline_over(history: list[tuple[str, dict]], key: str,
-                  direction: str, metric: str | None) -> tuple[float, str] | None:
-    """(best value, which file it came from) for one headline key."""
+                  direction: str, metric: str | None,
+                  provenance: tuple[str, int] | None = None
+                  ) -> tuple[float, str] | None:
+    """(best value, which file it came from) for one headline key.
+
+    ``provenance``: the candidate's ``(backend, device_count)`` — rows
+    measured on different hardware are skipped (a 1-device history must
+    not gate an 8-device run, or vice versa), the same matched-rows
+    discipline the ``value`` key applies via ``metric``."""
     best: tuple[float, str] | None = None
     for name, parsed in history:
         v = parsed.get(key)
@@ -86,6 +113,8 @@ def baseline_over(history: list[tuple[str, dict]], key: str,
             continue
         if key == "value" and metric is not None \
                 and parsed.get("metric") != metric:
+            continue
+        if provenance is not None and _provenance(parsed) != provenance:
             continue
         v = float(v)
         if (best is None
@@ -98,10 +127,11 @@ def baseline_over(history: list[tuple[str, dict]], key: str,
 def check(candidate: dict, history: list[tuple[str, dict]],
           tol: float) -> int:
     metric = candidate.get("metric")
+    prov = _provenance(candidate)
     rows = []
     failures = 0
     for key, direction in HEADLINE.items():
-        base = baseline_over(history, key, direction, metric)
+        base = baseline_over(history, key, direction, metric, prov)
         cand = candidate.get(key)
         if base is None:
             rows.append((key, "-", "-", "no-baseline"))
@@ -122,6 +152,8 @@ def check(candidate: dict, history: list[tuple[str, dict]],
         failures += bad
         rows.append((key, f"{base[0]:.4f} ({base[1]})",
                      f"{cand:.4f}", f"{verdict} ({delta:+.1%})"))
+    print(f"provenance filter: backend={prov[0]} devices={prov[1]} "
+          f"(unstamped history rows count as cpu/1)")
     w0 = max(len(r[0]) for r in rows)
     w1 = max(len(r[1]) for r in rows)
     w2 = max(len(r[2]) for r in rows)

@@ -21,11 +21,14 @@ if "xla_force_host_platform_device_count" not in _flags:
 # vmapped round programs recompile identically every run), so warm
 # runs skip most of the wall-clock. Separate dir from the TPU bench
 # cache (.jax_cache) to keep either side prunable on its own.
+# Min-compile-time 0: the suite's wall-clock is the SUM of hundreds
+# of sub-second compiles, so the default 1s floor would persist
+# almost none of it.
 os.environ.setdefault(
     "JAX_COMPILATION_CACHE_DIR",
     str(pathlib.Path(__file__).resolve().parent.parent / ".jax_cache_cpu"),
 )
-os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")
 
 import jax  # noqa: E402

@@ -12,3 +12,19 @@ def aggregate(parts, tracer):
         tracer.count(f"agg_{len(parts)}")    # gated: free when off
     tracer.count("agg_total")                # static key
     return step(stacked)
+
+
+def run_rounds(cohorts, sharding):
+    dev = jax.device_put(cohorts, sharding)  # hoisted: one placement
+    for batch in dev:
+        step(batch)
+
+
+def run_streamed(gather, sharding, step, n):
+    nxt = jax.device_put(gather(0), sharding)    # pre-loop: fine
+    for t in range(n):
+        cur = nxt
+        # the sanctioned double-buffer seam: the copy for step t+1
+        # overlaps step t's compute, so it is off the critical path
+        nxt = jax.device_put(gather(t + 1), sharding)  # fedlint: disable=recompile-hazard
+        step(cur)

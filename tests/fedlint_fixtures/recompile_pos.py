@@ -1,5 +1,5 @@
 """Positive: the §7b storm class — stack in a loop, jit in a loop,
-ungated f-string counter key."""
+ungated f-string counter key, device_put in a loop."""
 import jax
 import jax.numpy as jnp
 
@@ -11,3 +11,9 @@ def aggregate(parts, tracer):
         fn = jax.jit(lambda x: x + 1)    # fresh callable per iteration
     tracer.count(f"agg_{len(parts)}")    # allocates with tracing off
     return outs, fn
+
+
+def run_rounds(cohorts, sharding, step):
+    for batch in cohorts:
+        dev = jax.device_put(batch, sharding)  # copy on the critical path
+        step(dev)

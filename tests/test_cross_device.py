@@ -332,6 +332,19 @@ def test_cross_device_config_validation():
     with pytest.raises(ValueError, match="clients_per_round"):
         CrossDeviceConfig(n_clients=10, clients_per_round=20,
                           cohort_size=2)
+    # round 20 knobs: shard divisibility, prefetch enum, axis exclusion
+    assert CrossDeviceConfig(n_clients=100, clients_per_round=16,
+                             cohort_size=4, cohort_shards=2).active
+    with pytest.raises(ValueError, match="cohort_shards"):
+        CrossDeviceConfig(n_clients=100, clients_per_round=10,
+                          cohort_size=5, cohort_shards=3)
+    with pytest.raises(ValueError, match="prefetch"):
+        CrossDeviceConfig(n_clients=100, clients_per_round=10,
+                          cohort_size=5, prefetch="magic")
+    with pytest.raises(ValueError, match="does not compose"):
+        CrossDeviceConfig(n_clients=100, clients_per_round=16,
+                          cohort_size=4, cohort_shards=2,
+                          prefetch="stream")
 
 
 def test_scenario_classes_fail_loud_on_wrong_regime():
@@ -422,6 +435,363 @@ def test_dirichlet_partition_vectorized_path_matches_law():
     again = dirichlet_partition(labels, 512, alpha=0.5, seed=11)
     for a, b in zip(parts, again):
         assert np.array_equal(a, b)
+
+
+# --------------------------------------------------------------------
+# round 20: sharded cohort scan + streamed client state
+# --------------------------------------------------------------------
+
+def test_sharded_scan_parity_and_zero_recompiles():
+    """ISSUE 18 acceptance gate: the shard_map arm (cohort chunks
+    mapped over the cohorts mesh axis) must equal the single-device
+    scan of the SAME chunked schedule bit-for-bit — params AND
+    optimizer state, tolerance 0 — and neither arm may recompile after
+    warm-up under per-round resampling. Runs in a subprocess with 4
+    forced host devices (the flag only takes effect pre-jax-init)."""
+    import os
+
+    code = r"""
+import os, json
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count=4").strip()
+import jax, numpy as np, jax.numpy as jnp
+jax.config.update("jax_platforms", "cpu")
+import sys; sys.path.insert(0, %r)
+from p2pfl_tpu.config.schema import ModelConfig
+from p2pfl_tpu.learning.learner import make_step_fns
+from p2pfl_tpu.models.base import build_model
+from p2pfl_tpu.obs import trace as obs_trace
+from p2pfl_tpu.parallel.federated import (build_round_fn_cross_device,
+                                          init_federation)
+from p2pfl_tpu.parallel.mesh import cohort_shard_mesh
+
+assert jax.device_count() == 4
+fns = make_step_fns(build_model(ModelConfig(model="mlp")), batch_size=8)
+n, s, c = 4, 8, 4  # c divisible by the 4 shards
+rng = np.random.default_rng(18)
+
+def draw():
+    x = rng.normal(size=(c, n, s, 28, 28, 1)).astype(np.float32)
+    y = rng.integers(0, 10, size=(c, n, s)).astype(np.int32)
+    mask = np.ones((c, n, s), bool)
+    sizes = rng.integers(1, s + 1, size=(c, n)).astype(np.int32)
+    alive = rng.random((c, n)) > 0.2
+    alive[0, 0] = True
+    return x, y, mask, sizes, alive
+
+single = jax.jit(build_round_fn_cross_device(fns, epochs=1,
+                                             cohort_shards=4))
+sharded = jax.jit(build_round_fn_cross_device(
+    fns, epochs=1, cohort_shards=4, cohort_mesh=cohort_shard_mesh(4)))
+x0 = draw()[0]
+fed_a = init_federation(fns, jnp.asarray(x0[0, 0, :1]), n, seed=18)
+fed_b = init_federation(fns, jnp.asarray(x0[0, 0, :1]), n, seed=18)
+
+def to_host(fed):
+    # normalize feedback placement: the mesh arm's outputs are
+    # mesh-sharded, and feeding them straight back would retrace the
+    # jit as a different-layout SPMD program (the scenario manages
+    # placement through its transport; here the gate is the round
+    # FUNCTION, so every call gets host arrays = one program)
+    return jax.tree.map(
+        lambda t: np.asarray(t) if hasattr(t, "shape") else t, fed)
+
+assert obs_trace.install_xla_listener() is True
+params_eq = opt_eq = True
+for r in range(3):
+    batch = draw()
+    fed_a, la = single(fed_a, *batch)
+    fed_b, lb = sharded(fed_b, *batch)
+    if r == 0:  # warm-up round compiled both arms; count from here
+        jax.block_until_ready((fed_a, fed_b))
+        obs_trace.reset_xla_counters()
+    for a, b in zip(jax.tree.leaves(fed_a.states.params),
+                    jax.tree.leaves(fed_b.states.params)):
+        params_eq &= bool(np.array_equal(np.asarray(a), np.asarray(b)))
+    for a, b in zip(jax.tree.leaves(fed_a.states.opt_state),
+                    jax.tree.leaves(fed_b.states.opt_state)):
+        opt_eq &= bool(np.array_equal(np.asarray(a), np.asarray(b)))
+    fed_a, fed_b = to_host(fed_a), to_host(fed_b)
+print("VERDICT " + json.dumps({
+    "params_eq": params_eq, "opt_eq": opt_eq,
+    "recompiles": obs_trace.xla_recompiles()}))
+""" % (str(__import__("pathlib").Path(__file__).resolve().parent.parent),)
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)  # the child pins cpu itself
+    res = subprocess.run([sys.executable, "-c", code], env=env,
+                         capture_output=True, text=True, timeout=600)
+    assert res.returncode == 0, res.stderr[-2000:]
+    verdict = next(json.loads(ln[len("VERDICT "):])
+                   for ln in res.stdout.splitlines()
+                   if ln.startswith("VERDICT "))
+    assert verdict["params_eq"], "sharded params diverged from single-device scan"
+    assert verdict["opt_eq"], "sharded opt_state diverged from single-device scan"
+    assert verdict["recompiles"] == 0, verdict
+
+
+def test_sharded_chunked_dead_client_zero_weight():
+    """Dead-client invariance survives sharding: with cohort_shards=2
+    (the chunked schedule every mesh arm is bit-equal to), a dead
+    cohort member's data is inert — zeroing its size and garbaging its
+    shard changes nothing."""
+    from p2pfl_tpu.parallel.federated import (
+        build_round_fn_cross_device,
+        init_federation,
+    )
+
+    n, s, c = 4, 8, 2
+    rng = np.random.default_rng(7)
+    x = rng.normal(size=(c, n, s, 28, 28, 1)).astype(np.float32)
+    y = rng.integers(0, 10, size=(c, n, s)).astype(np.int32)
+    mask = np.ones((c, n, s), bool)
+    sizes = np.full((c, n), s, np.int32)
+
+    fns = _mk_fns()
+    cross = jax.jit(build_round_fn_cross_device(fns, epochs=1,
+                                                cohort_shards=2))
+    fed_a = init_federation(fns, jnp.asarray(x[0, 0, :1]), n, seed=3)
+    fed_b = init_federation(fns, jnp.asarray(x[0, 0, :1]), n, seed=3)
+
+    alive = np.ones((c, n), bool)
+    alive[1, 2] = False  # second chunk's cohort, slot 2 dead
+    fed_a, _ = cross(fed_a, x, y, mask, sizes, alive)
+
+    sizes_b = sizes.copy()
+    sizes_b[1, 2] = 0
+    x_b = x.copy()
+    x_b[1, 2] = 999.0
+    fed_b, _ = cross(fed_b, x_b, y, mask, sizes_b, alive)
+    for a, b in zip(jax.tree.leaves(fed_a.states.params),
+                    jax.tree.leaves(fed_b.states.params)):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_sample_cohorts_prefetch_order_deterministic():
+    """The streamed driver's prefetch order IS the cohort order, and
+    that order is a pure function of (seed, round): same key, same
+    cohorts; different round, different draw; and the cohort matrix is
+    exactly the flat K-draw reshaped row-major (cohort t = the t-th
+    consecutive slot-block), so host gather order never drifts from
+    the compiled schedule."""
+    from p2pfl_tpu.federation.sampling import sample_cohorts
+
+    sampled, cohorts = sample_cohorts(1000, 64, 8, round_num=5, seed=42)
+    again_s, again_c = sample_cohorts(1000, 64, 8, round_num=5, seed=42)
+    assert np.array_equal(sampled, again_s)
+    assert np.array_equal(cohorts, again_c)
+    assert cohorts.shape == (8, 8)
+    assert np.array_equal(cohorts.reshape(-1), sampled)
+    # the flat draw is the round-13 sampler verbatim — resampling
+    # changes the draw (and therefore the prefetch order) per round
+    assert np.array_equal(sampled,
+                          sample_clients(1000, 64, round_num=5, seed=42))
+    other, _ = sample_cohorts(1000, 64, 8, round_num=6, seed=42)
+    assert not np.array_equal(sampled, other)
+    with pytest.raises(ValueError, match="cohort_size"):
+        sample_cohorts(1000, 64, 7, round_num=0, seed=0)
+
+
+def test_cohort_batch_buffer_reuse_identical_values():
+    """cohort_batch(out=...) into a dirty reused buffer materializes
+    the same values as a fresh allocation — the streamed double buffer
+    cannot change round math."""
+    from p2pfl_tpu.config.schema import DataConfig
+    from p2pfl_tpu.datasets.data import CrossDeviceData
+
+    data = CrossDeviceData.make(
+        DataConfig(dataset="mnist", synthetic_train=2048,
+                   synthetic_test=128, samples_per_node=16),
+        n_clients=64,
+    )
+    ids_a = np.array([3, 17, 41, 60])
+    ids_b = np.array([5, 5, 2, 63])
+    fresh_a = data.cohort_batch(ids_a)
+    fresh_b = data.cohort_batch(ids_b)
+    bufs = data.cohort_buffers(4)
+    bufs[0][:] = 123.0  # dirty the buffer: stale rows must be erased
+    bufs[1][:] = 9
+    bufs[2][:] = True
+    bufs[3][:] = 99
+    reused_a = data.cohort_batch(ids_a, out=bufs)
+    for f, r in zip(fresh_a, reused_a):
+        assert np.array_equal(f, r)
+    reused_b = data.cohort_batch(ids_b, out=bufs)  # second fill, same buffer
+    for f, r in zip(fresh_b, reused_b):
+        assert np.array_equal(f, r)
+    assert reused_b[0] is bufs[0]  # in place, not a copy
+    # O(1) size lookup agrees with the materialized mask
+    assert np.array_equal(data.cohort_sizes(ids_b),
+                          reused_b[2].sum(axis=1).astype(np.int32))
+
+
+def test_streamed_round_parity_with_materialized():
+    """prefetch="stream" is a data-movement change, not a math change:
+    the streamed scenario must match the materialize-everything
+    scenario bit-for-bit on every param leaf at every round, under
+    per-round resampling and a mid-run fault."""
+    from p2pfl_tpu.federation.scenario import CrossDeviceScenario
+
+    def cfg(prefetch):
+        return ScenarioConfig.from_dict({
+            "name": f"crossdev-{prefetch}", "n_nodes": 4,
+            "model": {"model": "mlp"},
+            "data": {"dataset": "mnist", "synthetic_train": 1024,
+                     "synthetic_test": 128, "batch_size": 16,
+                     "samples_per_node": 8},
+            "training": {"rounds": 2, "eval_every": 0},
+            "cross_device": {"n_clients": 100, "clients_per_round": 16,
+                             "cohort_size": 4, "seed": 1,
+                             "prefetch": prefetch},
+            "faults": [{"round": 1, "node": 2, "kind": "crash"}],
+        })
+
+    sc_off = CrossDeviceScenario(cfg("off"))
+    sc_on = CrossDeviceScenario(cfg("stream"))
+    for _ in range(2):
+        sc_off.run(rounds=1)
+        sc_on.run(rounds=1)
+        for a, b in zip(jax.tree.leaves(sc_off.fed.states.params),
+                        jax.tree.leaves(sc_on.fed.states.params)):
+            assert np.array_equal(np.asarray(a), np.asarray(b))
+    # the streamed driver published its throughput + prefetch gauges
+    assert sc_on.crossdev_last.get("crossdev_prefetch_mb") is not None
+    assert sc_on.crossdev_last.get("crossdev_prefetch_stall_s") is not None
+    sc_off.close()
+    sc_on.close()
+
+
+def test_sgd_accum_routed_scan_parity():
+    """With the Pallas gate forced on, the fused accumulate routes the
+    per-leaf FedAvg partial sum through pallas_gemm.sgd_accum (null
+    step, acc+weight only). The routed round must match the unfused
+    gemm reference to float32 tolerance (the reduction is reassociated,
+    so this is allclose, not bit-equal — the bit-equal contract is the
+    XLA-routed path, pinned above), and the gate must have recorded
+    pallas decisions for sgd_accum. Subprocess: the choose() cache is
+    process-wide, so the forced knob needs a fresh interpreter."""
+    import os
+
+    code = r"""
+import os, json
+import jax, numpy as np, jax.numpy as jnp
+jax.config.update("jax_platforms", "cpu")
+import sys; sys.path.insert(0, %r)
+from p2pfl_tpu.config.schema import ModelConfig
+from p2pfl_tpu.learning.learner import make_step_fns
+from p2pfl_tpu.models.base import build_model
+from p2pfl_tpu.ops import pallas_gemm
+from p2pfl_tpu.parallel.federated import (build_round_fn_cross_device,
+                                          init_federation)
+
+fns = make_step_fns(build_model(ModelConfig(model="mlp")), batch_size=8)
+n, s, c = 4, 8, 3
+rng = np.random.default_rng(21)
+x = rng.normal(size=(c, n, s, 28, 28, 1)).astype(np.float32)
+y = rng.integers(0, 10, size=(c, n, s)).astype(np.int32)
+mask = np.ones((c, n, s), bool)
+sizes = rng.integers(1, s + 1, size=(c, n)).astype(np.int32)
+alive = np.ones((c, n), bool)
+alive[2, 1] = False
+
+fused = jax.jit(build_round_fn_cross_device(fns, epochs=1,
+                                            fused_accumulate=True))
+unfused = jax.jit(build_round_fn_cross_device(fns, epochs=1,
+                                              fused_accumulate=False))
+fed_f = init_federation(fns, jnp.asarray(x[0, 0, :1]), n, seed=5)
+fed_u = init_federation(fns, jnp.asarray(x[0, 0, :1]), n, seed=5)
+# parity is judged after ONE round: the reassociated reduction is a
+# ~1-ulp effect there, while further rounds amplify it through the
+# training dynamics (same float, different trajectory)
+fed_f, _ = fused(fed_f, x, y, mask, sizes, alive)
+fed_u, _ = unfused(fed_u, x, y, mask, sizes, alive)
+max_diff, ok = 0.0, True
+for a, b in zip(jax.tree.leaves(fed_f.states.params),
+                jax.tree.leaves(fed_u.states.params)):
+    a, b = np.asarray(a), np.asarray(b)
+    max_diff = max(max_diff, float(np.abs(a - b).max()))
+    ok &= bool(np.allclose(a, b, rtol=1e-5, atol=1e-6))
+fed_f, _ = fused(fed_f, x, y, mask, sizes, alive)  # second round runs clean
+dec = {k: v for k, v in pallas_gemm.decisions().items()
+       if k.startswith("sgd_accum")}
+print("VERDICT " + json.dumps({
+    "ok": ok, "max_diff": max_diff,
+    "pallas_routed": any(v.get("impl") == "pallas" for v in dec.values()),
+    "n_decisions": len(dec)}))
+""" % (str(__import__("pathlib").Path(__file__).resolve().parent.parent),)
+    env = dict(os.environ)
+    env["P2PFL_PALLAS_GEMM"] = "on"  # forced: interpret-mode on CPU
+    env["JAX_PLATFORMS"] = "cpu"
+    res = subprocess.run([sys.executable, "-c", code], env=env,
+                         capture_output=True, text=True, timeout=600)
+    assert res.returncode == 0, res.stderr[-2000:]
+    verdict = next(json.loads(ln[len("VERDICT "):])
+                   for ln in res.stdout.splitlines()
+                   if ln.startswith("VERDICT "))
+    assert verdict["pallas_routed"], verdict  # the gate actually fired
+    assert verdict["ok"], f"pallas-routed accumulate drifted: {verdict}"
+
+
+@pytest.mark.slowtier
+def test_streamed_100k_peak_rss_bounded():
+    """The N=100k streamed acceptance gate: a round completes at
+    100,000 virtual clients while the host materializes exactly TWO
+    cohort buffers (identity-stable across rounds), and peak RSS stays
+    flat once warm — the residency bound that makes N=100k-1M a
+    config choice, not a memory budget. Subprocess: ru_maxrss is a
+    process-lifetime high-water mark, so the gate needs a fresh
+    interpreter. Slow tier (~40s: four 100k-client streamed rounds);
+    the two-buffer residency mechanism itself is covered fast by
+    test_streamed_round_parity_with_materialized and
+    test_cohort_batch_buffer_reuse_identical_values."""
+    import os
+
+    code = r"""
+import json, resource
+import jax
+jax.config.update("jax_platforms", "cpu")
+import sys; sys.path.insert(0, %r)
+from p2pfl_tpu.config.schema import (CrossDeviceConfig, DataConfig,
+                                     ScenarioConfig, TrainingConfig)
+from p2pfl_tpu.federation.scenario import CrossDeviceScenario
+
+cfg = ScenarioConfig(
+    name="crossdev100k", n_nodes=4,
+    data=DataConfig(dataset="mnist", synthetic_train=100_000,
+                    synthetic_test=1000, batch_size=32),
+    training=TrainingConfig(rounds=4, epochs_per_round=1,
+                            learning_rate=0.1, eval_every=0),
+    cross_device=CrossDeviceConfig(
+        n_clients=100_000, clients_per_round=256, cohort_size=32,
+        sampling="uniform", seed=0, prefetch="stream"),
+    seed=0,
+)
+sc = CrossDeviceScenario(cfg)
+sc.run(rounds=1)  # warm-up: compile + allocate the double buffer
+rss_warm_kb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+bufs_before = [id(a) for a in sc._stream_bufs[0]] + [id(a) for a in sc._stream_bufs[1]]
+sc.run(rounds=3)  # streamed rounds: residency must not grow
+bufs_after = [id(a) for a in sc._stream_bufs[0]] + [id(a) for a in sc._stream_bufs[1]]
+rss_peak_kb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+print("VERDICT " + json.dumps({
+    "n_bufs": len(sc._stream_bufs),
+    "bufs_stable": bufs_before == bufs_after,
+    "growth_mb": round((rss_peak_kb - rss_warm_kb) / 1024, 1),
+    "round_done": True}))
+sc.close()
+""" % (str(__import__("pathlib").Path(__file__).resolve().parent.parent),)
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    res = subprocess.run([sys.executable, "-c", code], env=env,
+                         capture_output=True, text=True, timeout=600)
+    assert res.returncode == 0, res.stderr[-2000:]
+    verdict = next(json.loads(ln[len("VERDICT "):])
+                   for ln in res.stdout.splitlines()
+                   if ln.startswith("VERDICT "))
+    assert verdict["n_bufs"] == 2, verdict  # exactly two cohorts resident
+    assert verdict["bufs_stable"], verdict  # reused, never reallocated
+    # warm steady state: streamed rounds add no per-round residency
+    # (measured 0.0 on the dev box; 128 MB absorbs allocator noise)
+    assert verdict["growth_mb"] <= 128.0, verdict
 
 
 def test_cross_device_data_cohort_batch_shapes_and_determinism():

@@ -116,6 +116,31 @@ def test_wait_pct_column_terminal_and_html(tmp_path):
     assert "<td>40%</td>" in frag
 
 
+def test_crossdev_throughput_columns_terminal_and_html(tmp_path):
+    """Round 20: the CL/S and PF columns render the cross-device
+    throughput gauges (crossdev_clients_per_s, crossdev_prefetch_mb /
+    crossdev_prefetch_stall_s) and fall back to "-" on records from
+    per-node planes that never ran a cohort scan."""
+    from p2pfl_tpu.utils.monitor import render_table_html
+
+    publish_status(tmp_path, 0, {"role": "crossdev", "round": 3,
+                                 "crossdev_clients_per_s": 71.9,
+                                 "crossdev_prefetch_mb": 0.5,
+                                 "crossdev_prefetch_stall_s": 0.008})
+    publish_status(tmp_path, 1, {"role": "trainer", "round": 3})
+    table = render_table(read_statuses(tmp_path))
+    lines = table.splitlines()
+    assert lines[0].split()[9] == "CL/S"
+    assert lines[0].split()[10] == "PF"
+    assert lines[2].split()[9] == "72"  # 71.9 clients/s, whole-number cell
+    assert lines[2].split()[10] == "0M/0.01s"
+    assert lines[3].split()[9] == "-"  # per-node plane: no cohort scan
+    assert lines[3].split()[10] == "-"
+    frag = render_table_html(read_statuses(tmp_path))
+    assert "<th>CL/S</th>" in frag and "<th>PF</th>" in frag
+    assert "<td>72</td>" in frag and "<td>0M/0.01s</td>" in frag
+
+
 def test_watch_once_writes_both_outputs(tmp_path, capsys):
     from p2pfl_tpu.utils.monitor import watch
 

@@ -128,7 +128,7 @@ def _build(n: int, *, dataset="femnist", model="femnist-cnn",
            model_kwargs=None, shared_aggregate=False,
            surrogate_profile="hard",
            attack=None, malicious=None, reputation=False,
-           lora=None):
+           lora=None, dp=None, dp_mask=None):
     """Assemble one federated configuration into compiled programs.
 
     Returns a dict of everything the timing/trajectory helpers need.
@@ -202,7 +202,8 @@ def _build(n: int, *, dataset="femnist", model="femnist-cnn",
                        shared_aggregate=shared_aggregate,
                        identity_adopt=True,  # _build is always DFL
                        attack=attack, malicious=malicious,
-                       update_stats=reputation)
+                       update_stats=reputation,
+                       dp=dp, dp_mask=dp_mask)
     )
     shard = int(x.shape[1])
     bsz = min(batch_size, shard)
@@ -218,7 +219,8 @@ def _build(n: int, *, dataset="femnist", model="femnist-cnn",
         "fargs": fargs, "round_fn": round_fn, "reset": reset,
         "aggregator": aggregator,
         "attack": attack, "malicious": malicious,
-        "reputation": reputation, "mix_host": np.asarray(plan.mix),
+        "reputation": reputation, "dp": dp, "dp_mask": dp_mask,
+        "mix_host": np.asarray(plan.mix),
         "shard": shard, "used": (shard // bsz) * bsz,
         "config": dict(dataset=dataset, model=model, topology=topology,
                        partition=partition, batch_size=batch_size,
@@ -293,6 +295,7 @@ def _rebuild_body_round(run):
         identity_adopt=True,
         attack=run.get("attack"), malicious=run.get("malicious"),
         update_stats=bool(run.get("reputation")),
+        dp=run.get("dp"), dp_mask=run.get("dp_mask"),
     )
 
 
@@ -1079,6 +1082,19 @@ _LORA_KEYS = (
     "lora_accuracy_gap", "lora_xla_recompiles",
 )
 
+# keys the private phase (round 21: DP accuracy-vs-ε sweep + secagg
+# A/B) emits; static so BENCH_KEYS and the P2PFL_PRIVATE_DRY plan stay
+# authoritative
+_PRIVATE_KEYS = (
+    "private_n_nodes", "private_rounds", "private_clip_norm",
+    "private_delta", "private_acc_clean",
+    "private_acc_nm03", "private_eps_nm03",
+    "private_acc_nm06", "private_eps_nm06",
+    "private_acc_nm10", "private_eps_nm10",
+    "private_plain_round_s", "private_secagg_round_s",
+    "private_secagg_overhead_pct",
+)
+
 # Authoritative registry of every top-level key bench can emit.
 # scripts/check_bench_keys.py asserts each one is documented in
 # docs/perf.md (§10 key reference) and that no emission site uses a
@@ -1135,6 +1151,8 @@ BENCH_KEYS = (
     "aggd_dry", "aggd_keys", *_AGGD_KEYS,
     # lora (round 19: adapter-only federation A/B)
     "lora_dry", "lora_keys", *_LORA_KEYS,
+    # private (round 21: DP accuracy-vs-ε sweep + secagg overhead A/B)
+    "private_dry", "private_keys", *_PRIVATE_KEYS,
     # run-metadata stamp (round 12 regression gate provenance)
     "meta",
     # orchestration-test hook
@@ -1537,6 +1555,127 @@ def _phase_lora() -> None:
         part["lora_accuracy_gap"] = round(
             best_full["acc"] - best_lora["acc"], 4)
     _part(part)
+
+
+def _phase_private() -> None:
+    """Private federation (round 21): two independent measurements.
+
+    (a) **Accuracy-vs-ε** on the SPMD plane: femnist-cnn, 8 nodes,
+    fully connected, DP-FedAvg on every node (clip 1.0) at three noise
+    multipliers — each point records the final accuracy after the
+    fixed round budget and the accountant's closed-form ε at that
+    (σ, T, δ), plus the clean (no-DP) reference accuracy. Each point
+    streams its own part, so a mid-phase kill keeps the curve's
+    earlier points.
+
+    (b) **Secagg-vs-plain overhead** on the socket plane: the same
+    8-node mnist simulation with and without pairwise-mask secure
+    aggregation, interleaved min-of-2 via ``_ab_interleaved`` under
+    the perf-gate pairing discipline. The headline is
+    ``private_secagg_overhead_pct`` — the masking/quantization tax on
+    round wall time, gated "lower is better" in check_bench_regress.
+
+    ``P2PFL_PRIVATE_DRY=1`` emits the key plan without touching any
+    accelerator — the orchestration test's smoke hook."""
+    n, rounds, clip, delta = 8, 10, 1.0, 1e-5
+    noise_points = ((0.3, "nm03"), (0.6, "nm06"), (1.0, "nm10"))
+    if os.environ.get("P2PFL_PRIVATE_DRY") == "1":
+        _part({"private_dry": True, "private_keys": list(_PRIVATE_KEYS),
+               "private_n_nodes": n, "private_rounds": rounds,
+               "private_clip_norm": clip, "private_delta": delta})
+        return
+
+    import jax
+    import numpy as np
+
+    from p2pfl_tpu.privacy.dp import DPSpec, epsilon_at
+
+    _part({"private_n_nodes": n, "private_rounds": rounds,
+           "private_clip_norm": clip, "private_delta": delta})
+    kw = dict(topology="fully", samples_per_node=256, batch_size=64)
+    for nm, tag in ((None, "clean"), *noise_points):
+        try:
+            dp = (DPSpec(clip_norm=clip, noise_multiplier=nm, seed=0)
+                  if nm is not None else None)
+            run = _build(n, dp=dp,
+                         dp_mask=np.ones(n, bool) if dp else None, **kw)
+            part = {}
+            if nm is None:
+                part["private_acc_clean"] = round(
+                    _robust_final_acc(run, rounds=rounds), 4)
+            else:
+                part[f"private_acc_{tag}"] = round(
+                    _robust_final_acc(run, rounds=rounds), 4)
+                part[f"private_eps_{tag}"] = round(
+                    epsilon_at(nm, rounds, delta), 3)
+            _part(part)
+            run.clear()
+            jax.clear_caches()
+        except Exception as e:
+            print(f"private dp point {tag} failed: {e!r}"[:300],
+                  file=sys.stderr, flush=True)
+
+    # (b) socket-plane secagg A/B — CPU subprocess like the elastic
+    # socket arm (asyncio nodes cannot share the bench chip)
+    import json as _json
+    import subprocess
+
+    code = r"""
+import os, re, json
+flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+               os.environ.get("XLA_FLAGS", "")).strip()
+os.environ["XLA_FLAGS"] = flags
+import jax
+jax.config.update("jax_platforms", "cpu")
+import sys; sys.path.insert(0, %r)
+import bench
+from p2pfl_tpu.config.schema import (ScenarioConfig, TrainingConfig,
+    ProtocolConfig, DataConfig, PrivacyConfig)
+from p2pfl_tpu.p2p.launch import run_simulation
+
+def cfg(secagg):
+    return ScenarioConfig(
+        name="private8", n_nodes=%d, topology="fully",
+        data=DataConfig(dataset="mnist", samples_per_node=60),
+        training=TrainingConfig(rounds=3, epochs_per_round=1,
+                                learning_rate=0.05),
+        protocol=ProtocolConfig(heartbeat_period_s=0.5,
+                                aggregation_timeout_s=60.0,
+                                vote_timeout_s=10.0, train_set_size=%d),
+        privacy=PrivacyConfig(secagg=secagg),
+    )
+
+def arm(secagg):
+    return lambda: run_simulation(cfg(secagg), timeout=240)
+
+plain, masked = bench._ab_interleaved(arm(False), arm(True))
+print("BENCH_PRIVATE " + json.dumps({"plain": plain, "masked": masked}),
+      flush=True)
+""" % (_REPO, n, n)
+    try:
+        res = subprocess.run([sys.executable, "-c", code],
+                             capture_output=True, text=True, timeout=1100)
+        got = None
+        for line in res.stdout.splitlines():
+            if line.startswith("BENCH_PRIVATE "):
+                got = _json.loads(line[len("BENCH_PRIVATE "):])
+        if not got:
+            print(f"private socket child rc={res.returncode}: "
+                  f"{res.stderr[-400:]}", file=sys.stderr, flush=True)
+        else:
+            plain, masked = got.get("plain") or {}, got.get("masked") or {}
+            part = {
+                "private_plain_round_s": plain.get("round_s"),
+                "private_secagg_round_s": masked.get("round_s"),
+            }
+            if plain.get("round_s") and masked.get("round_s"):
+                part["private_secagg_overhead_pct"] = round(
+                    100.0 * (masked["round_s"] - plain["round_s"])
+                    / plain["round_s"], 2)
+            _part(part)
+    except Exception as e:
+        print(f"private secagg A/B failed: {e!r}"[:300], file=sys.stderr,
+              flush=True)
 
 
 def _phase_obs() -> None:
@@ -2912,6 +3051,7 @@ def main() -> None:
         ("chaos", "_phase_chaos", 120),
         ("aggd", "_phase_aggd", 120),
         ("lora", "_phase_lora", 150),
+        ("private", "_phase_private", 150),
         ("vit32", "_phase_vit32", 120),
     ]
     for name, fn, min_s in phases:

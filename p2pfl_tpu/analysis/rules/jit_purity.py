@@ -10,6 +10,12 @@ passed to a tracing entry point — and flags:
 - ``print(...)`` (use ``jax.debug.print`` for traced values),
 - NumPy host-transfer calls (``np.asarray``/``np.array``/``np.save``/
   ...) which force a device sync or fail on tracers,
+- host RNG calls (``np.random.*`` / ``random.*``): the draw happens
+  ONCE at trace time and bakes a constant into the compiled program —
+  every replay reuses the same "random" bits, which silently destroys
+  DP noise and attack-noise semantics. Use ``jax.random`` with an
+  explicit key (``jax.random.normal(key, ...)`` is pure and replays
+  fresh per key),
 - tracer/flight counter calls (``.count``/``.high_water``/``.span``),
 - mutation of non-local state (attribute stores, subscript stores to
   names not bound in the function — Pallas ``o_ref[...] = x`` stays
@@ -82,6 +88,14 @@ def _impurity(node: ast.AST, locals_: set[str]) -> str | None:
         if dn == "print":
             return ("print() runs once at trace time, never per step; "
                     "use jax.debug.print")
+        if dn.startswith(("np.random.", "numpy.random.", "random.")):
+            # checked BEFORE the host-transfer tails: np.random draws
+            # once at trace time and bakes a CONSTANT into the compiled
+            # program — fatal for DP noise, silent for everything else.
+            # "jax.random.normal" never matches ("jax." prefix).
+            return (f"'{dn}' draws host randomness once at trace time "
+                    "and replays the same bits forever; use jax.random "
+                    "with an explicit key")
         if (dn.startswith(("np.", "numpy."))
                 and tail_name(node.func) in _NP_HOST_TAILS):
             return (f"'{dn}' forces a host transfer (or fails on a "

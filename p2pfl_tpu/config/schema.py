@@ -491,6 +491,69 @@ class LoraConfig:
 
 
 @dataclasses.dataclass
+class PrivacyConfig:
+    """Private federation (privacy package, round 21): DP-FedAvg and
+    pairwise-mask secure aggregation, both off by default.
+
+    ``dp=True`` clips every node's outgoing update to L2 norm
+    ``clip_norm`` (global flatten — adapter-sized under lora) and adds
+    Gaussian noise of std ``clip_norm * noise_multiplier``, applied
+    bit-identically inside the SPMD jit and on the socket host
+    (privacy.dp.privatize_update). The (ε, δ) spend at ``delta`` is
+    tracked by the closed-form RDP accountant; ``epsilon_budget > 0``
+    arms the ``epsilon-budget`` health rule (warn at 80%, crit at
+    100%).
+
+    ``secagg=True`` masks socket-plane updates with pairwise-
+    cancelling fixed-point masks (privacy.secagg) so aggregating peers
+    learn only the FedAvg sum; ``secagg_bits`` is the fixed-point
+    fraction width. The refusal matrix in ScenarioConfig rejects the
+    planes that structurally need unmasked updates (cosine-reputation
+    scoring, the sidecar's raw-slot fuse).
+    """
+
+    # ---- DP-FedAvg (both planes) ---------------------------------------
+    dp: bool = False
+    clip_norm: float = 1.0
+    noise_multiplier: float = 0.0
+    delta: float = 1e-5
+    epsilon_budget: float = 0.0  # 0 = no budget rule
+    # ---- pairwise-mask secure aggregation (socket plane) ---------------
+    secagg: bool = False
+    secagg_bits: int = 24
+
+    def __post_init__(self):
+        if self.dp:
+            if not self.clip_norm > 0.0:
+                raise ValueError(
+                    f"privacy.clip_norm must be > 0, got {self.clip_norm}"
+                )
+            if self.noise_multiplier < 0.0:
+                raise ValueError(
+                    f"privacy.noise_multiplier must be >= 0, "
+                    f"got {self.noise_multiplier}"
+                )
+            if not 0.0 < self.delta < 1.0:
+                raise ValueError(
+                    f"privacy.delta must be in (0, 1), got {self.delta}"
+                )
+        if self.epsilon_budget < 0.0:
+            raise ValueError(
+                f"privacy.epsilon_budget must be >= 0, "
+                f"got {self.epsilon_budget}"
+            )
+        if not 8 <= self.secagg_bits <= 40:
+            raise ValueError(
+                f"privacy.secagg_bits must be in [8, 40], "
+                f"got {self.secagg_bits}"
+            )
+
+    @property
+    def active(self) -> bool:
+        return self.dp or self.secagg
+
+
+@dataclasses.dataclass
 class NodeConfig:
     """Per-node overrides (device_args in the reference), including the
     round-11 compute class: ``epochs`` overrides the federation-wide
@@ -549,6 +612,11 @@ class ScenarioConfig:
     # refusal matrix in __post_init__ rejects the planes that would
     # silently fuse full weights.
     lora: LoraConfig = dataclasses.field(default_factory=LoraConfig)
+    # private federation (round 21): DP-FedAvg clip+noise on both
+    # planes and/or pairwise-mask secure aggregation on the socket
+    # plane — see PrivacyConfig. The refusal matrix in __post_init__
+    # rejects the planes that structurally need raw per-client updates.
+    privacy: PrivacyConfig = dataclasses.field(default_factory=PrivacyConfig)
     # weight-exchange collective schedule: "dense" = all-gather einsum;
     # "sparse" = per-edge-offset ppermute (O(degree) ICI traffic, DFL +
     # one node per device only); "auto" picks sparse when it is legal
@@ -699,6 +767,45 @@ class ScenarioConfig:
                 )
             # staged exchange overlap composes: the double buffer
             # carries whatever tree the learner trains — adapters.
+        if self.privacy.secagg:
+            # masked updates are uniform noise until the quorum sum
+            # closes — refuse every plane that needs to READ individual
+            # updates (the sparse-transport refusal idiom: fail loud
+            # instead of silently scoring/fusing garbage).
+            if self.adversary.reputation:
+                raise ValueError(
+                    "privacy.secagg composes with reputation=False "
+                    "only: cosine-similarity scoring needs raw "
+                    "per-client updates, which masking makes "
+                    "indistinguishable from uniform noise"
+                )
+            if self.aggregation_plane == "sidecar":
+                raise ValueError(
+                    "privacy.secagg requires aggregation_plane="
+                    "'inline': the sidecar's raw-slot FedAvg kernel "
+                    "fuses float payloads and cannot run the modular "
+                    "uint64 sum masks cancel in"
+                )
+            if self.wire_dtype != "f32":
+                raise ValueError(
+                    "privacy.secagg requires wire_dtype='f32': masked "
+                    "payloads are exact uint64 ring elements — lossy "
+                    "wire quantization would break mask cancellation"
+                )
+            if self.elastic.async_aggregation:
+                raise ValueError(
+                    "privacy.secagg requires elastic.async_aggregation"
+                    "=False: stale entries re-enter rounds their masks "
+                    "were not derived for, so the pairwise terms would "
+                    "not cancel"
+                )
+        if self.privacy.active and self.cross_device.active:
+            raise ValueError(
+                "privacy is not wired into the cross_device cohort-"
+                "scan round yet: sampled clients are stateless rows "
+                "with no per-node (seed, round, idx) noise stream or "
+                "pairwise mask identity"
+            )
         if not self.nodes:
             self.nodes = self._default_nodes()
         if len(self.nodes) != self.n_nodes:
@@ -788,6 +895,7 @@ class ScenarioConfig:
             ("elastic", ElasticConfig),
             ("cross_device", CrossDeviceConfig),
             ("lora", LoraConfig),
+            ("privacy", PrivacyConfig),
         ]:
             if field in d and isinstance(d[field], dict):
                 d[field] = cls(**d[field])

@@ -95,6 +95,17 @@ class Scenario(Observable):
                 "live row per node; use CrossDeviceScenario for the "
                 "sampled K-of-N regime"
             )
+        if config.privacy.secagg:
+            # the sparse-transport × attack precedent: the SPMD round
+            # has no per-pair wire to mask — every row reads the stacked
+            # params directly, so "secure aggregation" here would be
+            # theater. Fail loud; secagg is a socket-plane feature.
+            raise ValueError(
+                "privacy.secagg is a socket-plane feature (pairwise "
+                "masks ride the PARAMS wire); the SPMD Scenario shares "
+                "one device array and has nothing to mask — run the "
+                "socket plane (p2p.launch) instead"
+            )
         self.config = config
         n = config.n_nodes
         self.dataset = dataset or FederatedDataset.make(config.data, n)
@@ -195,6 +206,30 @@ class Scenario(Observable):
             if adv.reputation else None
         )
 
+        # ---- privacy wiring (round 21): every training node's
+        # outgoing update is clipped + noised in-jit, keyed by
+        # (config.seed, node, round) — the same streams the socket
+        # plane draws, so the planes privatize bit-identically. The
+        # accountant's ε is a pure function of rounds completed, so
+        # every process reads the same spend from config alone.
+        priv = config.privacy
+        self.dp_spec = None
+        self.accountant = None
+        if priv.dp:
+            from p2pfl_tpu.privacy.dp import DPSpec, PrivacyAccountant
+
+            self.dp_spec = DPSpec(
+                clip_norm=priv.clip_norm,
+                noise_multiplier=priv.noise_multiplier,
+                seed=config.seed,
+            )
+            self.accountant = PrivacyAccountant(
+                priv.noise_multiplier, delta=priv.delta
+            )
+        self.dp_mask = (
+            self._base_trains.copy() if priv.dp else np.zeros(n, bool)
+        )
+
         # ---- elasticity wiring (round 11): in async mode a straggler
         # of compute class k delivers updates ~k-1 rounds stale, and
         # the SPMD twin of the socket session's entry-weight discount
@@ -270,6 +305,8 @@ class Scenario(Observable):
                 malicious=self.malicious,
                 update_stats=self.reputation is not None,
                 exchange_overlap=config.exchange_overlap,
+                dp=self.dp_spec,
+                dp_mask=self.dp_mask,
             )
         self._round_fn = tr.compile_round(round_fn)
         self._eval_fn = tr.compile_eval(build_eval_fn(self.fns))
@@ -321,9 +358,10 @@ class Scenario(Observable):
             and type(self.aggregator) is FedAvg
             # the ppermute path never materializes the full params
             # stack, so there is no pre-exchange hook for update
-            # poisoning and no trust_obs metric for reputation
+            # poisoning, DP privatization, or trust_obs reputation
             and not (self.attack is not None and self.attack.poisons_updates)
             and self.reputation is None
+            and self.dp_spec is None
         )
         if cfg.transport == "dense":
             return False
@@ -331,8 +369,9 @@ class Scenario(Observable):
             if not legal:
                 raise ValueError(
                     "transport='sparse' needs DFL + FedAvg + one node "
-                    "per device, and no update-poisoning adversary or "
-                    f"reputation (n_nodes={cfg.n_nodes}, "
+                    "per device, and no update-poisoning adversary, "
+                    "reputation, or DP privatization "
+                    f"(n_nodes={cfg.n_nodes}, "
                     f"n_devices={self.transport.n_devices}, "
                     f"federation={cfg.federation})"
                 )
@@ -548,6 +587,14 @@ class Scenario(Observable):
                         round(float(self.reputation.trust[i]), 4)
                         if self.reputation is not None else None
                     ),
+                    "dp_epsilon": (
+                        round(self.accountant.epsilon, 4)
+                        if self.accountant is not None else None
+                    ),
+                    "dp_epsilon_budget": (
+                        self.config.privacy.epsilon_budget
+                        if self.accountant is not None else None
+                    ),
                     "recompiles": obs_trace.xla_recompiles(),
                 },
             )
@@ -623,6 +670,11 @@ class Scenario(Observable):
 
                 train_loss = self._node_host(
                     metrics["train_loss"]).astype(np.float64)
+                if self.accountant is not None:
+                    # ε is a pure function of rounds completed, so a
+                    # resumed run re-reads the same spend (r counts
+                    # from the checkpoint's round, not zero)
+                    self.accountant.steps = r + 1
                 if self.reputation is not None and "trust_obs" in metrics:
                     # round r ran on trust from round r-1 (one-round
                     # lag); fold in this round's scores for the next.

@@ -33,6 +33,13 @@ Built-in rules (severity in parentheses; all thresholds live on
 - ``accuracy-divergence`` (warn): a node's accuracy sits
   ``divergence`` below the cohort median (statuses first, newest
   ``metrics.jsonl`` Test/accuracy rows as fallback).
+- ``epsilon-budget`` (warn → crit): a node's published DP spend
+  (``dp_epsilon`` in the status record, from the privacy accountant)
+  reached ``eps_warn_frac`` (warn) or 100% (crit) of the configured
+  ``dp_epsilon_budget``. A crit here means the formal (ε, δ)
+  guarantee the run was provisioned for is EXHAUSTED — every further
+  round leaks beyond the stated budget, which is an operator-stop
+  condition, not a performance smell.
 - ``partition-suspected`` (crit): the live cohort's per-peer byte
   counters (``peer_bytes_in``/``peer_bytes_out`` in the status
   records) split into 2+ disjoint reachability components — traffic
@@ -93,6 +100,9 @@ class HealthConfig:
     recompile_storm: int = 32
     divergence: float = 0.15
     min_cohort: int = 3  # cohort-relative rules need a real median
+    # epsilon-budget: warn when dp_epsilon reaches this fraction of
+    # dp_epsilon_budget; crit at/over the full budget
+    eps_warn_frac: float = 0.8
     # sidecar-stalled: descriptor-queue depth at/above this while slot
     # releases sit flat across two evaluations reads as a wedged aggd
     sidecar_backlog: int = 4
@@ -231,6 +241,32 @@ def rule_accuracy_divergence(snap: Snapshot,
     ]
 
 
+def rule_epsilon_budget(snap: Snapshot, eng: "HealthEngine") -> list[dict]:
+    """DP spend vs budget, judged per node from the status records the
+    accountant already publishes. Fires warn at ``eps_warn_frac`` of
+    the budget and crit at/over 100% — past that point the federation
+    is spending privacy it never provisioned. Inert unless a record
+    carries BOTH a spend and a positive budget, so non-DP runs (and DP
+    runs that opted out of a budget) never see it."""
+    out = []
+    for rec in snap.alive():
+        eps, budget = rec.get("dp_epsilon"), rec.get("dp_epsilon_budget")
+        if eps is None or not budget:
+            continue
+        eps, budget = float(eps), float(budget)
+        frac = eps / budget
+        if frac >= 1.0:
+            out.append({"node": int(rec.get("node", -1)), "severity": "crit",
+                        "message": f"DP budget exhausted: eps {eps:.3f} >= "
+                                   f"budget {budget:.3f}"})
+        elif frac >= snap.cfg.eps_warn_frac:
+            out.append({"node": int(rec.get("node", -1)), "severity": "warn",
+                        "message": f"DP spend eps {eps:.3f} at "
+                                   f"{100 * frac:.0f}% of budget "
+                                   f"{budget:.3f}"})
+    return out
+
+
 def _peer_totals(rec: dict) -> dict[int, int] | None:
     """Combined per-peer wire totals from one status record; None when
     the record predates the per-link counters. JSON stringifies the
@@ -346,6 +382,7 @@ def default_rules() -> list[Rule]:
         Rule("byte-rate", "warn", rule_byte_rate),
         Rule("recompile-storm", "warn", rule_recompile_storm),
         Rule("accuracy-divergence", "warn", rule_accuracy_divergence),
+        Rule("epsilon-budget", "warn", rule_epsilon_budget),
         Rule("partition-suspected", "crit", rule_partition_suspected),
         Rule("sidecar-stalled", "warn", rule_sidecar_stalled),
     ]

@@ -21,6 +21,7 @@ import asyncio
 import contextlib
 import dataclasses
 import json
+import math
 import pathlib
 import socket
 import subprocess
@@ -110,6 +111,81 @@ def _node_adversary_kwargs(cfg: ScenarioConfig, idx: int, data, setup):
             cutoff=cfg.adversary.reputation_cutoff,
         )
     return out
+
+
+def _node_privacy_kwargs(cfg: ScenarioConfig, idx: int,
+                         tls_dir: str | None = None) -> dict:
+    """Per-node P2PNode dp/masker kwargs — derived from config alone
+    (like _node_adversary_kwargs) so every process of a multi-process
+    federation privatizes with the SAME noise streams and derives the
+    SAME pair secrets. With a TLS dir (and the optional ``cryptography``
+    package) secagg pair secrets come from P-256 ECDH over the scenario
+    certs; otherwise the seeded fallback (see privacy.secagg's threat
+    model)."""
+    priv = cfg.privacy
+    out: dict = {}
+    if priv.dp:
+        from p2pfl_tpu.privacy.dp import DPSpec
+
+        out["dp"] = DPSpec(clip_norm=priv.clip_norm,
+                           noise_multiplier=priv.noise_multiplier,
+                           seed=cfg.seed)
+    if priv.secagg:
+        from p2pfl_tpu.privacy.secagg import PairwiseMasker
+
+        out["masker"] = PairwiseMasker(
+            idx, root_seed=cfg.seed, bits=priv.secagg_bits,
+            pair_secrets=_tls_pair_secrets(tls_dir, idx, cfg.n_nodes),
+        )
+    return out
+
+
+def _tls_pair_secrets(tls_dir: str | None, idx: int,
+                      n: int) -> dict[int, bytes] | None:
+    """ECDH pair secrets off the scenario TLS identity layer, or None
+    (→ seeded fallback) when there is no TLS dir or no ``cryptography``
+    package in this interpreter."""
+    if not tls_dir:
+        return None
+    try:
+        from cryptography import x509
+        from cryptography.hazmat.primitives import serialization
+    except ImportError:
+        return None
+    from p2pfl_tpu.privacy.secagg import pair_secrets_from_tls
+
+    d = pathlib.Path(tls_dir)
+    key_path = d / f"node{idx}.key"
+    if not key_path.exists():
+        return None
+    private_key = serialization.load_pem_private_key(
+        key_path.read_bytes(), password=None
+    )
+    peer_certs = {}
+    for j in range(n):
+        cert_path = d / f"node{j}.crt"
+        if j != idx and cert_path.exists():
+            peer_certs[j] = x509.load_pem_x509_certificate(
+                cert_path.read_bytes()
+            )
+    return pair_secrets_from_tls(idx, private_key, peer_certs)
+
+
+def _privacy_status(cfg: ScenarioConfig, round_num: int) -> dict:
+    """DP spend gauges for a status record: the accountant's ε is a
+    pure function of (config, rounds completed), so every process —
+    and the monitor/health plane reading the records — sees the same
+    number with no cross-process state."""
+    priv = cfg.privacy
+    if not priv.dp:
+        return {}
+    from p2pfl_tpu.privacy.dp import epsilon_at
+
+    eps = epsilon_at(priv.noise_multiplier, int(round_num), priv.delta)
+    return {
+        "dp_epsilon": round(eps, 4) if math.isfinite(eps) else eps,
+        "dp_epsilon_budget": priv.epsilon_budget,
+    }
 
 
 def _declares_full_mesh(cfg) -> bool:
@@ -208,6 +284,7 @@ async def _run_node(cfg: ScenarioConfig, idx: int, ports: list[int],
     data = FederatedDataset.make(cfg.data, n)  # deterministic: same shards
     adv_kwargs = _node_adversary_kwargs(cfg, idx, data,
                                         _adversary_setup(cfg))
+    priv_kwargs = _node_privacy_kwargs(cfg, idx, tls_dir=tls_dir)
     from p2pfl_tpu.learning.lora import maybe_wrap_lora
 
     learner = JaxLearner(
@@ -247,6 +324,7 @@ async def _run_node(cfg: ScenarioConfig, idx: int, ports: list[int],
         joiner=resume,
         sidecar=sidecar,
         **adv_kwargs,
+        **priv_kwargs,
     )
     await node.start()
     topo = generate_topology(cfg.topology, n, **cfg.topology_kwargs)
@@ -294,6 +372,7 @@ async def _run_node(cfg: ScenarioConfig, idx: int, ports: list[int],
                      "peer_bytes_in": dict(node.peer_bytes_in),
                      "peer_bytes_out": dict(node.peer_bytes_out),
                      "recompiles": obs_trace.xla_recompiles(),
+                     **_privacy_status(cfg, node.round),
                      **_critpath_status(node),
                      **_crossdev_status(learner),
                      **_aggd_status(sidecar)},
@@ -431,6 +510,9 @@ async def _simulate(cfg: ScenarioConfig, timeout: float = 600) -> dict:
     adv_kwargs = [
         _node_adversary_kwargs(cfg, i, data, adv_setup) for i in range(n)
     ]
+    # in-process simulation has no TLS layer: secagg maskers run in
+    # seeded-fallback pair-secret mode (privacy.secagg threat model)
+    priv_kwargs = [_node_privacy_kwargs(cfg, i) for i in range(n)]
     # one shared sidecar for the whole in-process federation (simulation
     # mode models ONE host). Sizing: every session can hold up to n
     # payload slots for the whole round (full mesh, entries pinned
@@ -461,6 +543,7 @@ async def _simulate(cfg: ScenarioConfig, timeout: float = 600) -> dict:
             checkpoint_every=cfg.checkpoint_every,
             sidecar=sidecar,
             **adv_kwargs[i],
+            **priv_kwargs[i],
         )
         for i in range(n)
     ]
@@ -516,6 +599,10 @@ async def _simulate(cfg: ScenarioConfig, timeout: float = 600) -> dict:
             resume=resume,
             sidecar=sidecar,
             **adv_kwargs[i],
+            # fresh masker, same derived secrets: pair streams are a
+            # pure function of (seed, pair, round), so a rejoiner
+            # re-derives exactly what the fleet expects of it
+            **_node_privacy_kwargs(cfg, i),
         )
         nodes[i] = nd
         await nd.start()
@@ -565,6 +652,7 @@ async def _simulate(cfg: ScenarioConfig, timeout: float = 600) -> dict:
                      "peer_bytes_in": dict(nd.peer_bytes_in),
                      "peer_bytes_out": dict(nd.peer_bytes_out),
                      "recompiles": obs_trace.xla_recompiles(),
+                     **_privacy_status(cfg, nd.round),
                      **_critpath_status(nd),
                      **_crossdev_status(nd),
                      **_aggd_status(sidecar)},

@@ -138,6 +138,8 @@ class P2PNode:
         checkpoint_every: int = 0,
         resume: bool = False,
         sidecar=None,
+        dp=None,
+        masker=None,
     ):
         from p2pfl_tpu.p2p.session import AggregationSession, SidecarSession
 
@@ -197,6 +199,14 @@ class P2PNode:
         # so finish-time aggregation is trust-weighted
         self.attack = attack
         self.reputation = reputation
+        # privacy hooks (p2pfl_tpu.privacy): ``dp`` is a DPSpec — this
+        # node clips + noises its own trained update post-fit, keyed by
+        # (dp.seed, idx, round) so the SPMD row is bit-identical;
+        # ``masker`` is a PairwiseMasker — outgoing updates are
+        # pairwise-masked fixed-point trees and the session fuses in
+        # the modular domain, unmasking only at quorum close
+        self.dp = dp
+        self.masker = masker
         # wire precision for PARAMS payloads (config.wire_dtype). The
         # knob names what this node WANTS to ship; what it actually
         # ships to a given target set is negotiated per send: every
@@ -295,6 +305,14 @@ class P2PNode:
         # executor-side decodes never touch the loop).
         self.sidecar = sidecar
         self.loop_payload_touch_bytes = 0
+        if sidecar is not None and masker is not None:
+            # config.schema refuses this combination; a direct caller
+            # gets the same loud failure instead of a sidecar fuse that
+            # silently float-averages masked ring elements
+            raise ValueError(
+                "secagg masking needs the inline session: the sidecar "
+                "fuses raw slot bytes as floats, not the modular sum"
+            )
         if sidecar is not None:
             self.session: AggregationSession = SidecarSession(
                 aggregator,
@@ -315,6 +333,7 @@ class P2PNode:
                 else 1.0,
                 staleness_beta=el.staleness_beta
                 if el.async_aggregation else 0.0,
+                masker=masker,
             )
         self.membership = Membership(
             n_nodes, self.protocol, virtual=False,
@@ -851,7 +870,32 @@ class P2PNode:
         conn = self.peers.pop(node, None)
         if conn is not None:
             self._teardown_conn(conn)
+        self._secagg_on_evict(node)
         flight.dump(f"node{self.idx}.evicted_peer{node}")
+
+    def _secagg_on_evict(self, node: int) -> None:
+        """Dropout recovery: record the eviction and reveal this
+        node's per-round pair seed against the corpse so every
+        aggregator can reconstruct the dead pair's mask streams
+        (Bonawitz reveal — unmasks nothing of any survivor)."""
+        if (self.masker is None
+                or self.masker.round_num is None
+                or node not in self.masker.members
+                or node in self.masker.evicted):
+            return
+        self.masker.note_evicted(node)
+        seed = self.masker.reveal_share(node)
+        flight.record("secagg.reveal", node=self.idx, dead=node,
+                      round=self.masker.round_num)
+        self._track_task(
+            self.broadcast(Message(
+                MsgType.SECAGG_SHARE, self.idx,
+                {"dead": int(node),
+                 "round": int(self.masker.round_num),
+                 "seed": int(seed)},
+            )),
+            "secagg_share",
+        )
 
     async def _drain_send_q(self, peer: PeerState) -> None:
         """Backpressure writer for one connection: drains the peer's
@@ -1015,6 +1059,7 @@ class P2PNode:
             conn = self.peers.pop(gone_id, None)
             if conn is not None:
                 self._teardown_conn(conn)
+            self._secagg_on_evict(gone_id)
         elif t is MsgType.PARAMS:
             await self._on_params(peer, msg)
         elif t is MsgType.STATE_SYNC:
@@ -1045,6 +1090,20 @@ class P2PNode:
                 self._votes.setdefault(r, {})[msg.sender] = tuple(
                     int(c) for c in msg.body["candidates"]
                 )
+        elif t is MsgType.SECAGG_SHARE:
+            # survivor's reveal for an evicted member's pair: file it
+            # with the masker (stale-round shares are pruned at the
+            # next begin_round), and mirror the eviction locally —
+            # which also reveals OUR pair seed against the corpse once,
+            # so reveals propagate quorum-wide even before every
+            # survivor's own probe gives up on the dead node
+            if self.masker is not None:
+                self.masker.add_share(
+                    int(msg.sender), int(msg.body["dead"]),
+                    int(msg.body["round"]), int(msg.body["seed"]),
+                )
+                if int(msg.body["round"]) == self.masker.round_num:
+                    self._secagg_on_evict(int(msg.body["dead"]))
         elif t is MsgType.TRANSFER_LEADERSHIP:
             # round fencing: the dedup ring is bounded, so a recorded
             # genuine transfer could be re-flooded rounds later after
@@ -2016,9 +2075,31 @@ class P2PNode:
                           self.idx, self.round, self.attack)
         )
 
+    def _privatize_own_update(self, ref) -> None:
+        """DP-FedAvg: clip + noise the trained params ONCE in place —
+        the privatized tree then backs the own-session add_model AND
+        every _send_params, exactly like the SPMD path's privatized row
+        entering every mix. ``ref`` is the round-start params; keyed by
+        (dp.seed, idx, round) so the SPMD row is bit-identical."""
+        from p2pfl_tpu.privacy.dp import dp_key, privatize_update_jit
+
+        flight.record("dp.privatize", node=self.idx, round=self.round)
+        self.learner.set_parameters(
+            privatize_update_jit(
+                self.learner.get_parameters(), ref,
+                self.dp.clip_norm, self.dp.noise_multiplier,
+                dp_key(self.dp.seed, self.idx, self.round),
+            )
+        )
+
     async def _train_round(self) -> None:
         train_set = await self._vote_train_set()
         self.session.clear()
+        if self.masker is not None:
+            # fresh pair-mask streams for this round's member set; a
+            # mid-round eviction then knows exactly which pairs may
+            # need reconstruction at quorum close
+            self.masker.begin_round(self.round, train_set)
         # Snapshot the effective role and token position for the WHOLE
         # round: a TRANSFER_LEADERSHIP that lands mid-round must not
         # flip this round's behavior (it takes effect next round), or a
@@ -2037,10 +2118,11 @@ class P2PNode:
         if role in ("aggregator", "server"):
             self.session.set_nodes_to_aggregate(train_set)
             # round-start params: the delta reference for reputation
-            # scoring of everything this session will aggregate (set
-            # BEFORE the pending replay below — a replayed model can
-            # complete coverage and finish the session immediately)
-            if self.reputation is not None:
+            # scoring — and under secagg the dtype/shape template the
+            # masked sum dequantizes against at close (set BEFORE the
+            # pending replay below — a replayed model can complete
+            # coverage and finish the session immediately)
+            if self.reputation is not None or self.masker is not None:
                 self.session.set_reference(self.learner.get_parameters())
         else:
             self.session.set_waiting_aggregated_model()
@@ -2060,14 +2142,24 @@ class P2PNode:
                 msg._slot = None
         if role in ("aggregator", "server"):
             ref = (self.learner.get_parameters()
-                   if self._poisons_updates() else None)
+                   if self._poisons_updates() or self.dp is not None
+                   else None)
             await self._fit()
-            if ref is not None:
+            if self._poisons_updates():
                 self._poison_own_update(ref)
+            if self.dp is not None:
+                # privatize AFTER any poisoning (the clip then also
+                # bounds injected updates — deployment semantics,
+                # matching the SPMD round fn's ordering)
+                self._privatize_own_update(ref)
             n_samples = self.learner.get_num_samples()[0]
-            covered = self.session.add_model(
-                self.learner.get_parameters(), (self.idx,), n_samples
-            )
+            own = self.learner.get_parameters()
+            if self.masker is not None:
+                # the masked tree is what enters the session AND what
+                # gossip forwards — the raw update never leaves the
+                # learner
+                own = self.masker.mask_update(own, n_samples)
+            covered = self.session.add_model(own, (self.idx,), n_samples)
             await self.broadcast(
                 Message(MsgType.MODELS_AGGREGATED, self.idx,
                         {"contributors": sorted(covered),
@@ -2076,11 +2168,17 @@ class P2PNode:
             await self._gossip_until_done(train_set, role, leader_at_start)
         elif role == "trainer":
             ref = (self.learner.get_parameters()
-                   if self._poisons_updates() else None)
+                   if self._poisons_updates() or self.dp is not None
+                   else None)
             await self._fit()
-            if ref is not None:
+            if self._poisons_updates():
                 self._poison_own_update(ref)
+            if self.dp is not None:
+                self._privatize_own_update(ref)
             n_samples = self.learner.get_num_samples()[0]
+            own = self.learner.get_parameters()
+            if self.masker is not None:
+                own = self.masker.mask_update(own, n_samples)
             target = (
                 leader_at_start if leader_at_start in self.peers else None
             )
@@ -2089,8 +2187,7 @@ class P2PNode:
                 else list(self.peers.values())
             )
             await self._send_params(
-                sent_to, self.learner.get_parameters(), (self.idx,),
-                n_samples, _ef=True,
+                sent_to, own, (self.idx,), n_samples, _ef=True,
             )
             await self._wait_done()
         else:  # idle / proxy: adopt whatever aggregate arrives

@@ -76,6 +76,13 @@ class MsgType(enum.Enum):
     # "node X left" must reach everyone, not just direct peers, or
     # multi-hop members stall at the round barrier until the timeout
     STOP = "stop"
+    # secure-aggregation dropout recovery (privacy.secagg): a survivor
+    # reveals its per-round pair seed against an evicted member so
+    # every aggregator can reconstruct and subtract the dead pair's
+    # mask streams at quorum close. Flooded: every aggregator needs
+    # every survivor's share, relays included. Reveals nothing about
+    # any surviving pair (Bonawitz reveal semantics).
+    SECAGG_SHARE = "secagg_share"
     # direct messages
     CONNECT = "connect"
     PARAMS = "params"
@@ -102,6 +109,7 @@ GOSSIPED = frozenset(
         MsgType.MODELS_AGGREGATED,
         MsgType.MODEL_INITIALIZED,
         MsgType.STOP,
+        MsgType.SECAGG_SHARE,
     }
 )
 

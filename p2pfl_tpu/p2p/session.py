@@ -66,8 +66,15 @@ class AggregationSession:
     def __init__(self, aggregator: Aggregator | None = None,
                  timeout_s: float = 60.0, reputation=None,
                  lane: str | None = None, min_received: float = 1.0,
-                 staleness_beta: float = 0.0):
+                 staleness_beta: float = 0.0, masker=None):
         self.aggregator = aggregator or FedAvg()
+        #: optional privacy.secagg.PairwiseMasker — when set, entries
+        #: are pairwise-masked uint64 trees (weights already folded in
+        #: at quantize time): fusion is the exact modular sum, and
+        #: _finish unmasks/dequantizes against the round-start
+        #: reference. config.schema refuses the planes that need raw
+        #: per-entry updates (reputation scoring, the sidecar fuse).
+        self.masker = masker
         self.timeout_s = timeout_s  # AGGREGATION_TIMEOUT
         #: async close quorum as a fraction of the expected train set;
         #: 1.0 = classic synchronous behavior (full coverage or timeout)
@@ -265,7 +272,9 @@ class AggregationSession:
         # inside every receiver's own finish, so scaling it at build
         # time would compound the trust discount sender x receiver
         keys = list(self.models.keys())
-        if (self.reputation is not None and self.reference is not None
+        if (self.masker is None
+                and self.reputation is not None
+                and self.reference is not None
                 and len(self.models) >= 3):
             # observe BEFORE aggregating: unlike SPMD (where scores
             # come out of the jitted round fn and can only shape the
@@ -279,9 +288,28 @@ class AggregationSession:
                 self.reference,
                 [(k, p) for k, (p, _) in self.models.items()],
             )
-        params, contribs, _ = self._aggregate(
+        params, contribs, total = self._aggregate(
             list(self.models.values()), keys=keys
         )
+        if self.masker is not None:
+            # quorum close is the ONLY point masked bits become a
+            # model: reconstruct + subtract evicted members' mask
+            # residue, then dequantize against the round-start
+            # reference (the dtype/shape template)
+            from p2pfl_tpu.privacy.secagg import SecaggError
+
+            if self.reference is None:
+                raise SecaggError(
+                    "masked session closed without a round-start "
+                    "reference (set_reference) to dequantize against"
+                )
+            params, unmasked_dead = self.masker.unmask(
+                params, total, self.covered, self.reference
+            )
+            flight.record("secagg.unmask", lane=self._lane,
+                          entries=len(keys),
+                          covered=sorted(self.covered),
+                          dead=unmasked_dead)
         # owning-copy boundary at session close: the multi-entry numpy
         # result already owns its accumulators (free pass-through), but
         # a single-entry round returns the stored tree as-is — its
@@ -298,6 +326,22 @@ class AggregationSession:
         if len(entries) == 1:
             p, w = entries[0]
             return p, (), w
+        if self.masker is not None:
+            # masked entries carry their weight folded into the
+            # quantized integers — fusion is the exact mod-2^64 tree
+            # sum, NO re-weighting (a float weighted mean would
+            # destroy mask cancellation). Partial aggregates built
+            # here stay in the masked domain and compose downstream.
+            from p2pfl_tpu.privacy.secagg import masked_sum
+
+            t0 = time.perf_counter()
+            with self._tracer.span(
+                "session.aggregate", lane=self._lane,
+                args={"path": "masked_modular", "n": len(entries)},
+            ):
+                tree, total = masked_sum(entries)
+            self.agg_wall_s += time.perf_counter() - t0
+            return tree, (), total
         # ONE effective-weights computation feeding BOTH execution
         # paths below — reputation (or any future weight shaping)
         # cannot be silently dropped by the numpy fast path

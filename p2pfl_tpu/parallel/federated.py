@@ -236,6 +236,8 @@ def build_round_fn(
     malicious: np.ndarray | None = None,
     update_stats: bool = False,
     exchange_overlap: str = "off",
+    dp=None,
+    dp_mask: np.ndarray | None = None,
 ) -> Callable:
     """Build the jittable ``round_fn(fed, x, y, mask, n_samples, plan
     arrays) -> (fed, metrics)``.
@@ -289,6 +291,17 @@ def build_round_fn(
     neither: it never materializes the full params stack, so there is
     no pre-exchange hook — robustness runs use this dense builder.
 
+    ``dp`` (a ``privacy.dp.DPSpec``) + ``dp_mask`` privatize outgoing
+    updates AFTER any attack injection and before the exchange: the
+    rows selected by the STATIC host mask ``dp_mask`` are replaced by
+    ``privacy.dp.privatize_stacked`` of themselves vs the round-start
+    params — clip to L2 ``clip_norm``, add Gaussian noise of std
+    ``clip_norm * noise_multiplier``, keyed by (dp.seed, node index,
+    fed.round) exactly like the socket node privatizing its learner
+    post-fit, so the two planes are bit-identical. Ordering matters:
+    poison-then-privatize means DP clipping also bounds what a
+    malicious row can inject, which is the deployment semantics.
+
     ``exchange_overlap="staged"`` double-buffers the exchange: the
     off-diagonal mix terms read the PREVIOUS round's post-fit params
     (``fed.stale``, seeded by :func:`with_staged_buffer`) at their then
@@ -306,6 +319,11 @@ def build_round_fn(
         and malicious is not None
         and bool(np.any(malicious))
         and getattr(attack, "poisons_updates", False)
+    )
+    dp_active = (
+        dp is not None
+        and dp_mask is not None
+        and bool(np.any(dp_mask))
     )
     if exchange_overlap not in ("off", "staged"):
         raise ValueError(
@@ -343,6 +361,21 @@ def build_round_fn(
             states = states.replace(
                 params=poison_stacked(
                     states.params, ref_params, malicious, fed.round, attack
+                )
+            )
+
+        # ---- DP-FedAvg: masked rows privatize their outgoing update
+        # (clip + noise vs round-start params) before it enters ANY mix
+        # — after poisoning, so the clip also bounds injected updates,
+        # and before the staged buffer capture, so stale hops ship
+        # privatized params too (matching the socket node privatizing
+        # its learner post-fit)
+        if dp_active:
+            from p2pfl_tpu.privacy.dp import privatize_stacked
+
+            states = states.replace(
+                params=privatize_stacked(
+                    states.params, ref_params, dp_mask, fed.round, dp
                 )
             )
 

@@ -1,0 +1,67 @@
+"""Private federation (ROADMAP item 5): DP-FedAvg on both planes +
+pairwise-mask secure aggregation on the socket plane.
+
+Two halves, one config surface (``config.schema.PrivacyConfig``):
+
+- :mod:`p2pfl_tpu.privacy.dp` — per-client update clipping +
+  calibrated Gaussian noise as one pure pytree transform, applied
+  bit-identically inside the SPMD jit and on the socket host, plus
+  the RDP (ε, δ) accountant feeding the monitor/health budget rule;
+- :mod:`p2pfl_tpu.privacy.secagg` — fixed-point pairwise masking with
+  exact modular cancellation at session quorum close, ECDH pair
+  agreement off the TLS identity layer (seeded fallback without the
+  optional ``cryptography`` dependency), and Bonawitz-style dropout
+  recovery riding the suspect/evict machinery.
+"""
+
+from p2pfl_tpu.privacy.dp import (
+    DPSpec,
+    PrivacyAccountant,
+    clip_factor,
+    dp_key,
+    epsilon_at,
+    noise_sigma,
+    privatize_stacked,
+    privatize_update,
+    privatize_update_jit,
+    update_norm,
+)
+from p2pfl_tpu.privacy.secagg import (
+    DEFAULT_BITS,
+    PairwiseMasker,
+    SecaggError,
+    SecaggUnmaskError,
+    dequantize_sum,
+    ecdh_pair_secret,
+    fallback_pair_secret,
+    masked_add,
+    masked_sum,
+    pair_secrets_from_tls,
+    quantize_update,
+    round_pair_seed,
+)
+
+__all__ = [
+    "DPSpec",
+    "PrivacyAccountant",
+    "clip_factor",
+    "dp_key",
+    "epsilon_at",
+    "noise_sigma",
+    "privatize_stacked",
+    "privatize_update",
+    "privatize_update_jit",
+    "update_norm",
+    "DEFAULT_BITS",
+    "PairwiseMasker",
+    "SecaggError",
+    "SecaggUnmaskError",
+    "dequantize_sum",
+    "ecdh_pair_secret",
+    "fallback_pair_secret",
+    "masked_add",
+    "masked_sum",
+    "pair_secrets_from_tls",
+    "quantize_update",
+    "round_pair_seed",
+]

@@ -1,0 +1,234 @@
+"""DP-FedAvg: per-client update clipping + calibrated Gaussian noise.
+
+ROADMAP item 5 — federations of real users need the aggregator to
+learn (almost) nothing per-client, not just robustness to attackers
+(Flower / NVIDIA FLARE name DP as a table-stakes capability,
+PAPERS.md). The privatization is ONE pure, jit-compatible pytree
+transform ``privatize_update(update, ref, clip_norm, noise_multiplier,
+key)`` — the exact shape of ``adversary/attacks.py::poison_update``:
+
+- the SPMD simulation path applies it inside the jitted round fn to
+  the rows of the stacked params selected by a STATIC mask
+  (``privatize_stacked`` below — a trace-time Python loop, so the
+  math per node is literally the same function call the socket path
+  makes);
+- the socket path applies it on the host (CPU backend) to the
+  learner's trained params post-fit, before they enter the node's own
+  session and every ``_send_params``.
+
+Same seed + same (node, round) ⇒ **bit-identical** privatized leaves
+on both paths — pinned by tests/test_privacy.py with tolerance 0, the
+same path-parity discipline the adversary transforms carry. That
+parity is what makes an accuracy-vs-ε curve measured on the fast SPMD
+path transferable to the socket deployment.
+
+``ref`` is the params the node started the round from (the previous
+aggregate it trained on): the DP guarantee is on the **update**
+``update - ref``, which is clipped to L2 norm ``clip_norm`` over the
+GLOBAL flatten and noised with per-leaf Gaussian draws of std
+``clip_norm * noise_multiplier``. The global-flatten norm means the
+transform works unchanged on adapter-only trees (DP × LoRA): the
+clip norm is then over the adapter flatten — the million-user shape,
+since the noise floor scales with the flatten dimension.
+
+The (ε, δ) spend is tracked by :class:`PrivacyAccountant` — an
+RDP/moments accountant for the full-participation Gaussian mechanism.
+Per composition step the Rényi divergence at order α is
+``α / (2 σ²)``; after ``T`` steps the optimal conversion to (ε, δ) has
+the closed form (minimizing ``T α / (2σ²) + ln(1/δ)/(α-1)`` over α):
+
+    ``ε = c + 2·sqrt(c · ln(1/δ))``  with  ``c = T / (2 σ²)``
+
+which tests/test_privacy.py re-derives by hand at three (σ, T)
+points. The running ε flows through status records → the monitor's
+EPS column → the ``epsilon-budget`` health rule (warn at 80%, crit at
+100% of ``epsilon_budget``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Params = Any
+
+
+@dataclasses.dataclass(frozen=True)
+class DPSpec:
+    """How a node privatizes its outgoing update.
+
+    ``clip_norm``         L2 bound on the update (global flatten).
+    ``noise_multiplier``  Gaussian std as a multiple of ``clip_norm``
+                          (σ in the accountant's calibration).
+    ``seed``              PRNG root; combined with (node_idx,
+                          round_num) via ``fold_in`` so every node and
+                          round draws distinct — but path-independent —
+                          noise.
+    """
+
+    clip_norm: float = 1.0
+    noise_multiplier: float = 0.0
+    seed: int = 0
+
+    def __post_init__(self):
+        if not self.clip_norm > 0.0:
+            raise ValueError(
+                f"dp clip_norm must be > 0, got {self.clip_norm}")
+        if self.noise_multiplier < 0.0:
+            raise ValueError(
+                f"dp noise_multiplier must be >= 0, "
+                f"got {self.noise_multiplier}")
+
+
+def dp_key(seed: int, node_idx, round_num) -> jax.Array:
+    """Deterministic per-(node, round) key — identical on both paths
+    (the ``attack_key`` derivation: root, fold node, fold round).
+    ``node_idx``/``round_num`` may be traced ints (the SPMD path folds
+    in ``fed.round``)."""
+    key = jax.random.PRNGKey(seed)
+    key = jax.random.fold_in(key, node_idx)
+    return jax.random.fold_in(key, round_num)
+
+
+def clip_factor(norm, clip_norm: float, xp=np):
+    """THE clip scale formula, shared verbatim by every consumer (the
+    ``staleness_scale`` pattern): ``min(1, C / max(norm, eps))`` in
+    float32. ``xp=np`` is the host side (bench/telemetry clip-fraction
+    accounting), ``xp=jnp`` runs inside the jitted round fn — the
+    parity test pins the two at tolerance 0 so the planes cannot
+    drift."""
+    n = xp.maximum(xp.asarray(norm, xp.float32), xp.float32(1e-12))
+    return xp.minimum(xp.float32(1.0), xp.float32(clip_norm) / n)
+
+
+def noise_sigma(clip_norm: float, noise_multiplier: float) -> np.float32:
+    """THE noise calibration: std = ``clip_norm * noise_multiplier``
+    (f32 on the host — both planes fold the same scalar into their
+    Gaussian draws)."""
+    return np.float32(np.float32(clip_norm) * np.float32(noise_multiplier))
+
+
+def update_norm(update: Params, ref: Params, xp=np):
+    """Global-flatten L2 norm of ``update - ref`` in f32 — the norm
+    the clip acts on, parametrized np/jnp like :func:`clip_factor`."""
+    sq = xp.float32(0.0)
+    for p, r in zip(jax.tree.leaves(update), jax.tree.leaves(ref)):
+        d = xp.asarray(p, xp.float32) - xp.asarray(r, xp.float32)
+        sq = sq + xp.sum(d * d)
+    return xp.sqrt(sq)
+
+
+def privatize_update(update: Params, ref: Params, clip_norm: float,
+                     noise_multiplier: float, key: jax.Array) -> Params:
+    """Privatize ONE node's outgoing update. Pure and jit-compatible;
+    preserves every leaf's shape and dtype.
+
+    Sends ``ref + clip(update - ref) + N(0, (C·σ_mult)²)`` where the
+    clip rescales the whole delta so its GLOBAL L2 norm is at most
+    ``clip_norm`` (per-leaf clipping would distort the update's
+    direction). Noise is drawn per leaf via ``fold_in(key, i)`` by
+    flatten POSITION — the same leaf order falls out of the same
+    pytree on both paths (serialize round-trips keep leaf order), so
+    the noise bits match exactly.
+    """
+    leaves, treedef = jax.tree.flatten(update)
+    ref_leaves = jax.tree.leaves(ref)
+    deltas = [p.astype(jnp.float32) - r.astype(jnp.float32)
+              for p, r in zip(leaves, ref_leaves)]
+    sq = jnp.float32(0.0)
+    for d in deltas:
+        sq = sq + jnp.sum(d * d)
+    scale = clip_factor(jnp.sqrt(sq), clip_norm, xp=jnp)
+    sigma = jnp.float32(noise_sigma(clip_norm, noise_multiplier))
+    out = []
+    for i, (p, r, d) in enumerate(zip(leaves, ref_leaves, deltas)):
+        lk = jax.random.fold_in(key, i)
+        noise = jax.random.normal(lk, p.shape, jnp.float32)
+        out.append(
+            (r.astype(jnp.float32) + scale * d
+             + sigma * noise).astype(p.dtype)
+        )
+    return jax.tree.unflatten(treedef, out)
+
+
+# Socket-plane entry point. The host MUST run the same COMPILED program
+# as the SPMD plane: op-by-op eager execution rounds after every
+# multiply and add, while XLA contracts ``a + s*b`` into a fused
+# multiply-add (one rounding) under jit — a 1-ulp divergence that would
+# break the tolerance-0 plane parity. clip_norm/noise_multiplier are
+# static so they enter the trace as constants, exactly as they do from
+# the DPSpec closure inside the jitted round fn.
+privatize_update_jit = jax.jit(privatize_update, static_argnums=(2, 3))
+
+
+def privatize_stacked(stacked: Params, ref_stacked: Params,
+                      mask: np.ndarray, round_num,
+                      spec: DPSpec) -> Params:
+    """Apply :func:`privatize_update` to the rows of a ``[n, ...]``-
+    stacked params tree selected by a STATIC boolean ``mask``.
+
+    The mask must be a host array (compile-time constant — it is
+    scenario config, not round data): selected rows are replaced via a
+    trace-time loop of ``.at[i].set(privatize_update(row_i))`` — each
+    privatized row is the EXACT same per-node computation the socket
+    path runs, which is what makes the two paths bit-identical
+    (vmapping the transform could legally reassociate the arithmetic).
+    """
+    mask = np.asarray(mask, bool)
+    out = stacked
+    for i in np.flatnonzero(mask):
+        i = int(i)
+        row = jax.tree.map(lambda x: x[i], stacked)
+        ref = jax.tree.map(lambda x: x[i], ref_stacked)
+        key = dp_key(spec.seed, i, round_num)
+        priv = privatize_update(row, ref, spec.clip_norm,
+                                spec.noise_multiplier, key)
+        out = jax.tree.map(lambda o, v: o.at[i].set(v), out, priv)
+    return out
+
+
+def epsilon_at(noise_multiplier: float, steps: int,
+               delta: float) -> float:
+    """(ε, δ)-DP spend of ``steps`` full-participation Gaussian
+    mechanism compositions at std multiplier σ — the closed-form
+    optimal RDP→DP conversion from the module docstring:
+    ``ε = c + 2·sqrt(c·ln(1/δ))`` with ``c = steps / (2σ²)``."""
+    if steps <= 0:
+        return 0.0
+    if noise_multiplier <= 0.0:
+        return math.inf
+    if not 0.0 < delta < 1.0:
+        raise ValueError(f"delta must be in (0, 1), got {delta}")
+    c = steps / (2.0 * noise_multiplier * noise_multiplier)
+    return c + 2.0 * math.sqrt(c * math.log(1.0 / delta))
+
+
+@dataclasses.dataclass
+class PrivacyAccountant:
+    """Running (ε, δ) ledger for one federation — a pure function of
+    the step count, so every plane (and every process of a socket
+    federation) reads the same ε from config + rounds-completed alone,
+    with no state to replicate."""
+
+    noise_multiplier: float
+    delta: float = 1e-5
+    steps: int = 0
+
+    def step(self, n: int = 1) -> None:
+        self.steps += int(n)
+
+    @property
+    def epsilon(self) -> float:
+        return epsilon_at(self.noise_multiplier, self.steps, self.delta)
+
+    def spent_fraction(self, epsilon_budget: float) -> float:
+        """Share of an ε budget consumed; inf budget (or 0 = no
+        budget) never reports spend."""
+        if not epsilon_budget or not math.isfinite(epsilon_budget):
+            return 0.0
+        return self.epsilon / float(epsilon_budget)

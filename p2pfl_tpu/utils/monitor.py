@@ -72,7 +72,7 @@ def read_statuses(directory: str | pathlib.Path) -> list[dict[str, Any]]:
 
 
 _COLUMNS = ("node", "role", "round", "loss", "accuracy", "trust",
-            "peers", "p95s", "wait%", "cl/s", "pf", "io_mb", "age",
+            "peers", "p95s", "wait%", "cl/s", "pf", "io_mb", "eps", "age",
             "health")
 
 
@@ -119,6 +119,19 @@ def _prefetch_cell(rec: dict[str, Any]) -> str:
     return f"{float(mb or 0):.0f}M/{float(st or 0):.2f}s"
 
 
+def _eps_cell(rec: dict[str, Any]) -> str:
+    """EPS cell: running DP spend from the privacy accountant,
+    ``<eps>/<budget>`` when a budget is configured, bare ``<eps>``
+    otherwise — "-" on non-DP runs."""
+    eps = rec.get("dp_epsilon")
+    if eps is None:
+        return "-"
+    budget = rec.get("dp_epsilon_budget")
+    if budget:
+        return f"{float(eps):.2f}/{float(budget):.2f}"
+    return f"{float(eps):.2f}"
+
+
 def _row(rec: dict[str, Any], now: float, liveness_s: float,
          alerts=None) -> dict[str, str]:
     # clamp: cross-host clock skew can put a record's ts slightly in
@@ -158,6 +171,9 @@ def _row(rec: dict[str, Any], now: float, liveness_s: float,
             "-" if bi is None and bo is None
             else f"{(bi or 0) / 1e6:.1f}/{(bo or 0) / 1e6:.1f}"
         ),
+        # privacy plane: running (ε, budget) spend from the DP
+        # accountant — feeds the epsilon-budget health rule
+        "eps": _eps_cell(rec),
         "age": f"{age:.1f}s" + ("" if alive else " DEAD"),
         # round-12 health plane: worst active alert for this node
         "health": _health_cell(rec.get("node"), alerts),

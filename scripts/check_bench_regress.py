@@ -56,6 +56,9 @@ HEADLINE: dict[str, str] = {
     "chaos_final_accuracy": "higher",
     "aggd_round_s_24node_uncapped": "lower",
     "lora_payload_reduction": "higher",
+    # round 21: the secagg masking/quantization tax on socket round
+    # wall time — the privacy plane's only perf headline
+    "private_secagg_overhead_pct": "lower",
 }
 DEFAULT_TOL = 0.15
 

@@ -24,6 +24,14 @@ def multi_out_kernel(p_ref, m_ref, g_ref, p_out, m_out, acc_out):
     acc_out[:] = acc_out[:] + p_out[:]
 
 
+@jax.jit
+def dp_noise_step(state, key):
+    # jax.random draws are PURE (keyed): fresh bits per key, replayed
+    # correctly — the sanctioned way to noise inside a traced fn
+    sub = jax.random.fold_in(key, 1)
+    return state + jax.random.normal(sub, state.shape, jnp.float32)
+
+
 def run(xs):
     out, ys = lax.scan(body, 0.0, xs)
     jitted = jax.jit(kernel)

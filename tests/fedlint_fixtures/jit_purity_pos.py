@@ -12,3 +12,11 @@ def train_step(state, batch, tracer):
     tracer.count("steps")      # counter frozen after trace
     METRICS["loss"] = 0.0      # non-local mutation
     return state, host
+
+
+@jax.jit
+def noisy_step(state):
+    import random
+    noise = np.random.normal(size=(4,))   # baked constant, not noise
+    jitter = random.random()              # same: one draw at trace
+    return state + noise + jitter

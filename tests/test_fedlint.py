@@ -35,7 +35,7 @@ _CASES = [
     ("donation-safety", "donation_pos.py", "donation_neg.py", 3),
     ("recompile-hazard", "recompile_pos.py", "recompile_neg.py", 4),
     ("async-hygiene", "async_pos.py", "async_neg.py", 3),
-    ("jit-purity", "jit_purity_pos.py", "jit_purity_neg.py", 4),
+    ("jit-purity", "jit_purity_pos.py", "jit_purity_neg.py", 6),
     ("atomic-artifact", "artifact_pos.py", "artifact_neg.py", 2),
 ]
 

@@ -113,6 +113,31 @@ class TestHealthEngine:
             ("trust-collapse", 1, "crit")
         ]
 
+    def test_epsilon_budget_warn_crit_and_clear(self):
+        """Round 21: DP spend vs budget — warn at 80%, crit at/over
+        100%, inert without a positive budget, and the alert clears
+        when the spend drops back (a fresh run re-publishing)."""
+        eng = HealthEngine(config=HealthConfig(eps_warn_frac=0.8))
+        t = 1000.0
+        recs = [_status(0, t, dp_epsilon=2.0, dp_epsilon_budget=10.0),
+                _status(1, t, dp_epsilon=8.5, dp_epsilon_budget=10.0),
+                _status(2, t, dp_epsilon=11.0, dp_epsilon_budget=10.0),
+                # no budget configured: rule must stay silent
+                _status(3, t, dp_epsilon=99.0, dp_epsilon_budget=0.0),
+                _status(4, t)]  # non-DP run
+        alerts = eng.evaluate(recs, now=t)
+        assert [(a.rule, a.node, a.severity) for a in alerts] == [
+            ("epsilon-budget", 2, "crit"),
+            ("epsilon-budget", 1, "warn"),
+        ]
+        assert eng.worst() == "crit"
+        # a fresh run's records under budget: both alerts clear
+        fresh = [_status(i, t + 1, dp_epsilon=0.5,
+                         dp_epsilon_budget=10.0) for i in range(3)]
+        assert eng.evaluate(fresh, now=t + 1) == []
+        clears = [tr for tr in eng.transitions if tr["event"] == "clear"]
+        assert {c["node"] for c in clears} == {1, 2}
+
     def test_byte_rate_anomaly_needs_cohort_and_floor(self):
         cfg = HealthConfig(byte_ratio=8.0, byte_floor=1e6, min_cohort=3)
         t = 1000.0
@@ -199,6 +224,22 @@ def test_healthcheck_cli_round_stall_fire_and_clear(tmp_path, capsys):
     rc = healthcheck_main([str(tmp_path)])
     assert rc == 0
     assert "healthy" in capsys.readouterr().out
+
+
+def test_healthcheck_cli_epsilon_budget_crit_exit_code(tmp_path, capsys):
+    """Round 21: an exhausted DP budget is an operator-stop condition —
+    the healthcheck CLI must exit 2 (crit) on it, so a watchdog can
+    halt the run before it spends privacy it never provisioned."""
+    status = tmp_path / "status"
+    publish_status(status, 0, {"round": 4, "dp_epsilon": 3.0,
+                               "dp_epsilon_budget": 10.0})
+    publish_status(status, 1, {"round": 4, "dp_epsilon": 12.5,
+                               "dp_epsilon_budget": 10.0})
+    rc = healthcheck_main([str(tmp_path), "--json"])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 2 and doc["severity"] == "crit"
+    assert [(a["rule"], a["node"]) for a in doc["alerts"]] \
+        == [("epsilon-budget", 1)]
 
 
 def test_healthcheck_cli_dead_node_exit_codes(tmp_path, capsys):

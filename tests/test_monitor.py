@@ -141,6 +141,28 @@ def test_crossdev_throughput_columns_terminal_and_html(tmp_path):
     assert "<td>72</td>" in frag and "<td>0M/0.01s</td>" in frag
 
 
+def test_eps_column_renders_dp_spend(tmp_path):
+    """Round 21: the EPS column renders the DP accountant's running
+    spend — ``eps/budget`` with a budget, bare ``eps`` without, "-" on
+    non-DP records — in the terminal table and the HTML fragment."""
+    from p2pfl_tpu.utils.monitor import render_table_html
+
+    publish_status(tmp_path, 0, {"role": "aggregator", "round": 3,
+                                 "dp_epsilon": 4.5,
+                                 "dp_epsilon_budget": 10.0})
+    publish_status(tmp_path, 1, {"role": "aggregator", "round": 3,
+                                 "dp_epsilon": 4.5})
+    publish_status(tmp_path, 2, {"role": "trainer", "round": 3})
+    table = render_table(read_statuses(tmp_path))
+    lines = table.splitlines()
+    assert lines[0].split()[12] == "EPS"
+    assert lines[2].split()[12] == "4.50/10.00"
+    assert lines[3].split()[12] == "4.50"
+    assert lines[4].split()[12] == "-"  # non-DP run: no eps
+    frag = render_table_html(read_statuses(tmp_path))
+    assert "<th>EPS</th>" in frag and "<td>4.50/10.00</td>" in frag
+
+
 def test_watch_once_writes_both_outputs(tmp_path, capsys):
     from p2pfl_tpu.utils.monitor import watch
 

@@ -99,25 +99,13 @@ _REPO = str(pathlib.Path(__file__).resolve().parent)
 
 BASELINE_ROUND_S = 15.0  # derived reference pacing floor, see docstring
 
-# bf16 peak FLOP/s per chip, by device_kind substring
-_PEAKS = {
-    "v5 lite": 197e12,  # v5e
-    "v5litepod": 197e12,
-    "v5p": 459e12,
-    "v6 lite": 918e12,  # Trillium
-    "v6e": 918e12,
-    "v4": 275e12,
-    "v3": 123e12,
-    "v2": 45e12,
-}
-
-
 def _peak_flops(device) -> float | None:
-    kind = getattr(device, "device_kind", "").lower()
-    for key, peak in _PEAKS.items():
-        if key in kind:
-            return peak
-    return None
+    """bf16 peak FLOP/s per chip. The table moved to
+    p2pfl_tpu.obs.cost_model.PEAKS (module-level jax-free) so the live
+    devprof MFU gauge and this bench divide by the same denominator;
+    imported lazily to keep the parent process jax-free regardless."""
+    from p2pfl_tpu.obs.cost_model import peak_flops
+    return peak_flops(device)
 
 
 def _build(n: int, *, dataset="femnist", model="femnist-cnn",
@@ -987,6 +975,18 @@ _OBS_ATTR_SPANS = ("node.round", "node.fit", "learner.fit",
                    "learner.evaluate", "session.add_model",
                    "session.aggregate", "scenario.round", "p2p.verify")
 
+# keys the devprof phase (round 20: device-level step profiling +
+# MFU/HBM gauges) emits; static so BENCH_KEYS and the
+# P2PFL_DEVPROF_DRY plan stay authoritative
+_DEVPROF_KEYS = (
+    "devprof_round_s_off", "devprof_round_s_on", "devprof_overhead_pct",
+    "devprof_fit_s", "devprof_data_s", "devprof_forward_s",
+    "devprof_backward_s", "devprof_update_s", "devprof_accum_s",
+    "devprof_phase_sum_err_pct", "devprof_top_component",
+    "devprof_mfu_live", "devprof_mfu_bench", "devprof_mfu_err_pct",
+    "devprof_hbm_peak_mb",
+)
+
 # keys the comm phase (round 10: overlap + wire-dtype A/Bs) emits;
 # static so BENCH_KEYS and the P2PFL_COMM_DRY plan stay authoritative
 _COMM_KEYS = (
@@ -1137,6 +1137,8 @@ BENCH_KEYS = (
     # obs critical path (round 18: cross-node causal tracing)
     "critpath_wire_s_24node", "critpath_wait_s_24node",
     "critpath_sum_err_pct_24node",
+    # devprof (round 20: device-level step profiling + MFU/HBM gauges)
+    "devprof_dry", "devprof_keys", *_DEVPROF_KEYS,
     # comm (round 10: overlap + wire-dtype A/Bs)
     "comm_dry", "comm_keys", *_COMM_KEYS,
     # elastic (round 11: churn + straggler survival)
@@ -1832,6 +1834,171 @@ def _phase_obs() -> None:
                 cp_part["critpath_sum_err_pct_24node"] = round(
                     100.0 * max(errs), 2)
         _part(cp_part)
+
+
+def _phase_devprof() -> None:
+    """Device-level profiling plane (round 20), CPU backend (like the
+    obs phase: the cost being measured is host bookkeeping + small jit
+    programs, and the asyncio nodes cannot share the bench chip).
+
+    Three arms, streamed in gate order:
+
+    (a) **gauges overhead A/B** — the obs8-style federation with
+        ``P2PFL_DEVPROF`` off vs ``1`` (gauges: FLOP probe + MFU/HBM
+        reads per fit, production program untouched), interleaved
+        min-of-pairs exactly like ``obs_overhead_pct``. Emits
+        ``devprof_overhead_pct`` — the <=2% acceptance budget.
+    (b) **step-profiled traced run** — one federation with
+        ``P2PFL_DEVPROF=step`` + tracing: the merged trace carries the
+        ``devprof.*`` phase spans and the ``node.round`` spans, so a
+        single run yields the per-phase seconds, the
+        phases-vs-``learner.fit`` sum error (the <=10% gate at
+        federation scale) and ``obs.perf_report``'s ranked verdict
+        (``devprof_top_component`` — the real-run observable the
+        report's acceptance rides on).
+    (c) **live-vs-bench MFU agreement** — a bare headline-model
+        learner in gauges mode: the live ``devprof_mfu`` gauge against
+        a bench-side recomputation (external wall over the same honest
+        FLOPs), <=10% agreement.
+
+    ``P2PFL_DEVPROF_DRY=1`` emits the key plan without touching the
+    accelerator — the orchestration test's smoke hook."""
+    if os.environ.get("P2PFL_DEVPROF_DRY") == "1":
+        _part({"devprof_dry": True, "devprof_keys": list(_DEVPROF_KEYS)})
+        return
+
+    import re
+    import tempfile
+
+    os.environ["XLA_FLAGS"] = re.sub(
+        r"--xla_force_host_platform_device_count=\d+", "",
+        os.environ.get("XLA_FLAGS", "")).strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from p2pfl_tpu.config.schema import (
+        DataConfig,
+        ProtocolConfig,
+        ScenarioConfig,
+        TrainingConfig,
+    )
+    from p2pfl_tpu.obs import cost_model
+    from p2pfl_tpu.obs import critpath as _critpath
+    from p2pfl_tpu.obs import perf_report as _perf_report
+    from p2pfl_tpu.obs.devprof import PHASE_SPANS
+    from p2pfl_tpu.obs.trace import get_tracer
+    from p2pfl_tpu.p2p.launch import run_simulation
+
+    # CPU has no peak-FLOPs table entry: pin a synthetic peak so the
+    # MFU arithmetic is exercised end to end (the regression gate's
+    # provenance matching keeps cpu envelopes apart from real chips)
+    if cost_model.peak_flops() is None:
+        os.environ.setdefault(cost_model.ENV_PEAK, "1e12")
+
+    def cfg(log_dir=None):
+        return ScenarioConfig(
+            name="devprof8", n_nodes=8, topology="fully",
+            data=DataConfig(dataset="mnist", samples_per_node=60),
+            training=TrainingConfig(rounds=3, epochs_per_round=1,
+                                    learning_rate=0.05),
+            protocol=ProtocolConfig(heartbeat_period_s=0.5,
+                                    aggregation_timeout_s=60.0,
+                                    vote_timeout_s=10.0, train_set_size=8),
+            log_dir=log_dir,
+        )
+
+    def sim(devprof_mode: str, log_dir=None, traced=False) -> dict:
+        os.environ["P2PFL_DEVPROF"] = devprof_mode
+        os.environ["P2PFL_TRACE"] = "1" if traced else "0"
+        try:
+            if traced:
+                # one process runs several traced sims: drop the
+                # previous run's spans or attribution double-counts
+                get_tracer().reset()
+            return run_simulation(cfg(log_dir), timeout=240)
+        finally:
+            os.environ["P2PFL_DEVPROF"] = ""
+            os.environ["P2PFL_TRACE"] = "0"
+
+    # ---- (a) gauges overhead A/B, strict interleave + min-of-pairs
+    def on_run(tag, i, r):
+        if tag == "a" and i == 0:
+            _part({"devprof_round_s_off": r.get("round_s")})
+
+    best_off, best_on = _ab_interleaved(
+        lambda: sim(""), lambda: sim("1"), on_run=on_run)
+    part = {"devprof_round_s_off":
+                best_off["round_s"] if best_off else None,
+            "devprof_round_s_on":
+                best_on["round_s"] if best_on else None}
+    if best_off and best_on:
+        part["devprof_overhead_pct"] = round(
+            100.0 * (best_on["round_s"] - best_off["round_s"])
+            / best_off["round_s"], 2)
+    _part(part)
+
+    # ---- (b) step-profiled traced run -> phase split + attribution
+    with tempfile.TemporaryDirectory() as td:
+        sim("step", log_dir=td, traced=True)
+        doc = _critpath.load_merged([td])
+        attr = _perf_report.attribute(doc)
+        dp_part: dict = {}
+        phases = _perf_report.devprof_phases(doc)
+        for name in PHASE_SPANS:
+            if name in phases:
+                key = "devprof_" + name.split(".", 1)[1] + "_s"
+                dp_part[key] = round(phases[name]["total_s"], 4)
+        fit_tot = 0.0
+        fit_cnt = 0
+        for ev in doc.get("traceEvents", ()):
+            if ev.get("ph") == "X" and ev.get("name") == "learner.fit":
+                fit_tot += float(ev.get("dur", 0.0)) / 1e6
+                fit_cnt += 1
+        if fit_cnt:
+            dp_part["devprof_fit_s"] = round(fit_tot / fit_cnt, 4)
+        phase_sum = sum(p["total_s"] for p in phases.values())
+        if fit_tot and phases:
+            dp_part["devprof_phase_sum_err_pct"] = round(
+                100.0 * abs(phase_sum - fit_tot) / fit_tot, 2)
+        if attr.get("top"):
+            dp_part["devprof_top_component"] = attr["top"]
+        _part(dp_part)
+
+    # ---- (c) live gauge vs bench-side honest MFU on the headline model
+    from p2pfl_tpu.datasets import FederatedDataset
+    from p2pfl_tpu.learning.learner import JaxLearner
+    from p2pfl_tpu.models import get_model
+
+    fed = FederatedDataset.make(
+        DataConfig(dataset="femnist", samples_per_node=750), 1)
+    learner = JaxLearner(model=get_model("femnist-cnn"),
+                         data=fed.nodes[0], learning_rate=0.05, seed=0,
+                         batch_size=336)
+    learner.init()
+    learner.set_epochs(2)
+    os.environ["P2PFL_DEVPROF"] = "1"
+    try:
+        learner.fit()  # warm-up: jit compile + once-per-shape FLOP probe
+        t0 = time.monotonic()
+        learner.fit()
+        wall = time.monotonic() - t0
+    finally:
+        os.environ["P2PFL_DEVPROF"] = ""
+    live = dict(learner.devprof_last)
+    mfu_part: dict = {}
+    if live.get("devprof_hbm_peak_mb") is not None:
+        mfu_part["devprof_hbm_peak_mb"] = live["devprof_hbm_peak_mb"]
+    flops = cost_model.learner_fit_flops(learner)
+    peak = cost_model.peak_flops(jax.devices()[0])
+    if flops and peak and wall > 0:
+        bench_mfu = flops * 2 / wall / peak  # 2 epochs
+        mfu_part["devprof_mfu_bench"] = round(bench_mfu, 4)
+        if live.get("devprof_mfu"):
+            mfu_part["devprof_mfu_live"] = live["devprof_mfu"]
+            mfu_part["devprof_mfu_err_pct"] = round(
+                100.0 * abs(live["devprof_mfu"] - bench_mfu) / bench_mfu, 2)
+    _part(mfu_part)
 
 
 def _phase_obs_health() -> None:
@@ -3052,6 +3219,7 @@ def main() -> None:
         ("aggd", "_phase_aggd", 120),
         ("lora", "_phase_lora", 150),
         ("private", "_phase_private", 150),
+        ("devprof", "_phase_devprof", 120),
         ("vit32", "_phase_vit32", 120),
     ]
     for name, fn, min_s in phases:

@@ -132,10 +132,18 @@ def suppressed(finding: Finding, pragmas: dict[int, set[str] | None]) -> bool:
 # baseline
 # ---------------------------------------------------------------------
 
+# the --write-baseline scaffold marker; load_baseline refuses it, so a
+# regenerated baseline cannot be merged without a human justification
+SCAFFOLD_JUSTIFICATION = "TODO: justify or fix"
+
+
 def load_baseline(path: str | pathlib.Path) -> list[dict[str, Any]]:
     """Baseline entries (``[]`` when the file doesn't exist). Every
     entry must carry ``rule``/``path``/``code`` plus a one-line
-    ``justification`` — an unjustified grandfather is refused loudly."""
+    ``justification`` — an unjustified grandfather is refused loudly,
+    and so is the untouched ``--write-baseline`` scaffold text (the
+    original design accepted it as "non-empty", which let a freshly
+    regenerated baseline pass review with zero human words)."""
     path = pathlib.Path(path)
     if not path.is_file():
         return []
@@ -146,19 +154,26 @@ def load_baseline(path: str | pathlib.Path) -> list[dict[str, Any]]:
         if missing:
             raise ValueError(
                 f"baseline {path}: entry {e!r} lacks {sorted(missing)}")
-        if not str(e["justification"]).strip():
+        just = str(e["justification"]).strip()
+        if not just:
             raise ValueError(
                 f"baseline {path}: entry for {e['path']} ({e['rule']}) "
                 "has an empty justification")
+        if just == SCAFFOLD_JUSTIFICATION:
+            raise ValueError(
+                f"baseline {path}: entry for {e['path']} ({e['rule']}) "
+                "still carries the --write-baseline scaffold text "
+                f"{SCAFFOLD_JUSTIFICATION!r} — replace it with the "
+                "reason this finding is acceptable")
     return entries
 
 
 def write_baseline(path: str | pathlib.Path, findings: Iterable[Finding],
-                   justification: str = "TODO: justify or fix") -> None:
+                   justification: str = SCAFFOLD_JUSTIFICATION) -> None:
     """Regenerate the baseline from current findings (``--write-
     baseline``). Justifications default to a marker the reviewer must
-    replace — ``load_baseline`` accepts them (non-empty) but the PR
-    diff makes every new grandfather explicit."""
+    replace — ``load_baseline`` refuses the untouched marker, so the
+    PR cannot land until every new grandfather is explained."""
     entries = [
         {"rule": f.rule, "path": f.path, "code": f.code,
          "justification": justification}

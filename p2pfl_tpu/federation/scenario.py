@@ -57,11 +57,12 @@ from p2pfl_tpu.parallel.federated import (
     cross_device_wn,
     init_federation,
     make_round_plan,
+    round_flops,
     staleness_scale,
     with_staged_buffer,
 )
 from p2pfl_tpu.parallel.mesh import cohort_shard_mesh
-from p2pfl_tpu.obs import flight
+from p2pfl_tpu.obs import devprof, flight
 from p2pfl_tpu.obs import trace as obs_trace
 from p2pfl_tpu.parallel.transport import MeshTransport, edge_offsets
 from p2pfl_tpu.topology.topology import generate_topology
@@ -327,6 +328,11 @@ class Scenario(Observable):
             int(self._node_host(self.fed.round)) * self._steps_per_round
         )
         self._plan_cache: dict[tuple, tuple] = {}
+        # devprof round gauges (MFU/TFLOPs/HBM), refreshed per round
+        # when P2PFL_DEVPROF is on and splatted into status records.
+        # False = round FLOPs not probed yet (None = probed, uncounted)
+        self.devprof_last: dict[str, Any] = {}
+        self._devprof_flops: float | None | bool = False
 
     # ------------------------------------------------------------------
     def _node_host(self, x) -> np.ndarray:
@@ -596,6 +602,9 @@ class Scenario(Observable):
                         if self.accountant is not None else None
                     ),
                     "recompiles": obs_trace.xla_recompiles(),
+                    # one SPMD program serves every node, so the
+                    # devprof gauges (utilization/memory) are shared
+                    **self.devprof_last,
                 },
             )
 
@@ -666,6 +675,16 @@ class Scenario(Observable):
                 self.notify(Events.AGGREGATION_FINISHED, {"round": r})
                 dt = time.monotonic() - t0
                 round_times.append(dt)
+                if devprof.enabled():
+                    # the FLOP probe lowers the round program once per
+                    # run (shapes are fixed), AFTER dt is read so its
+                    # compile never bills itself to a round time
+                    if self._devprof_flops is False:
+                        self._devprof_flops = round_flops(
+                            self._round_fn, self.fed, *self._data_args,
+                            *self._plan_args(trains_vote))
+                    self.devprof_last = devprof.round_gauges(
+                        self._devprof_flops, dt, self.transport.n_devices)
                 self.global_step += self._steps_per_round
 
                 train_loss = self._node_host(
@@ -877,6 +896,8 @@ class CrossDeviceScenario(Observable):
         # live gauges for the monitor/launch status plumbing (round 20):
         # refreshed per round, splatted into status records
         self.crossdev_last: dict[str, Any] = {}
+        self.devprof_last: dict[str, Any] = {}
+        self._devprof_flops: float | None | bool = False
         self._x_test = self.transport.put_replicated(
             jnp.asarray(self.data.x_test))
         self._y_test = self.transport.put_replicated(
@@ -984,6 +1005,7 @@ class CrossDeviceScenario(Observable):
                 "peers": self.cd.n_slots - 1,
                 "recompiles": obs_trace.xla_recompiles(),
                 **self.crossdev_last,
+                **self.devprof_last,
             },
         )
 
@@ -1042,6 +1064,17 @@ class CrossDeviceScenario(Observable):
             jax.block_until_ready(self.fed.states.params)
             dt = time.monotonic() - t0
             round_times.append(dt)
+            if devprof.enabled():
+                # streamed rounds have no single round program to cost
+                # (per-step dispatch) — their gauges carry wall + memory
+                # watermarks only; the monolithic scan costs once
+                if self._devprof_flops is False:
+                    self._devprof_flops = (
+                        round_flops(self._round_fn, self.fed, *args)
+                        if not self._stream else None
+                    )
+                self.devprof_last = devprof.round_gauges(
+                    self._devprof_flops, dt, tr.n_devices)
             self.last_sampled = sampled
             self.last_cohorts = cohorts
             self.last_cohort_alive = c_alive

@@ -40,6 +40,7 @@ from p2pfl_tpu.learning.objectives import (
     masked_accuracy,
     ocsvm_penalty,
 )
+from p2pfl_tpu.obs import devprof
 from p2pfl_tpu.obs.trace import get_tracer
 
 
@@ -94,13 +95,25 @@ def make_optimizer(name: str = "sgd", learning_rate: float = 0.1,
 
 @dataclasses.dataclass(frozen=True)
 class StepFns:
-    """The pure-function core of a learner — safe to vmap/shard_map."""
+    """The pure-function core of a learner — safe to vmap/shard_map.
+
+    The ``prepare_epoch``/``forward``/``backward``/``apply_update``
+    quartet is the SAME step split into its phases (obs.devprof's
+    step-profiling pipeline): ``forward`` returns the ``jax.vjp``
+    residual closure so ``backward`` is the true cotangent pass —
+    no forward recompute inflating either span."""
 
     init: Callable  # (rng, sample_x) -> TrainState
     train_epochs: Callable  # (state, x, y, mask, epochs, gate=None)
     # -> (state, metrics); gate: per-node 1.0/0.0 update scale
     evaluate: Callable  # (params, x, y, mask) -> metrics dict
     tx: Any
+    # devprof phase split (None on hand-built StepFns that predate it)
+    prepare_epoch: Callable | None = None  # (state, x, y, mask)
+    # -> (rng', (bx, by, bm))
+    forward: Callable | None = None  # (params, bx, by, bm) -> (loss, vjp)
+    backward: Callable | None = None  # (vjp) -> grads
+    apply_update: Callable | None = None  # (state, grads, gate=None)
 
 
 def make_step_fns(
@@ -238,8 +251,45 @@ def make_step_fns(
         out = jax.lax.dot(oh, flat, precision=jax.lax.Precision.HIGHEST)
         return out.reshape((perm.shape[0],) + x.shape[1:])
 
-    def train_one_epoch(state: TrainState, xym, gate):
-        x, y, mask = xym
+    def apply_update(st: TrainState, grads, gate=None) -> TrainState:
+        """The optimizer-update phase of one step: explicit decay,
+        gating, fused-SGD routing, optax fallback — everything after
+        the gradient. ``train_one_epoch``'s scan body calls this, and
+        obs.devprof jits it standalone as the ``devprof.update`` span,
+        so the profiled pipeline applies the production update."""
+        if explicit_decay:
+            grads = jax.tree.map(
+                lambda g, p: g + explicit_decay * p, grads, st.params)
+        on = None
+        if gate is not None:
+            # zero grads AND updates instead of where-selecting whole
+            # trees afterward: params stay bit-exact for gated-off
+            # nodes (x + 0 == x) without an extra full-tree memory
+            # pass, and no real gradient leaks into momentum.
+            # ``where``, not ``* gate``: 0.0 * NaN is NaN, and a
+            # gated-off node whose shard produces a non-finite grad
+            # must stay frozen, not poisoned
+            on = gate > 0
+            grads = jax.tree.map(
+                lambda g: jnp.where(on, g, jnp.zeros_like(g)), grads)
+        fused = (_fused_sgd_step(st, grads, gate, on)
+                 if fuse_sgd else None)
+        if fused is not None:
+            params, opt_state = fused
+        else:
+            updates, opt_state = tx.update(grads, st.opt_state, st.params)
+            if gate is not None:
+                updates = jax.tree.map(
+                    lambda u: jnp.where(on, u, jnp.zeros_like(u)),
+                    updates)
+            params = optax.apply_updates(st.params, updates)
+        return st.replace(params=params, opt_state=opt_state,
+                          step=st.step + 1)
+
+    def prepare_epoch(state: TrainState, x, y, mask):
+        """The data/host-gather phase: fresh permutation + batch
+        layout for one epoch. ``train_one_epoch`` runs it inline;
+        devprof jits it standalone as the ``devprof.data`` span."""
         s = x.shape[0]
         bsz = min(batch_size, s)  # shards smaller than a batch still train
         steps = s // bsz
@@ -249,39 +299,30 @@ def make_step_fns(
         bx = _shuffle(x, perm).reshape((steps, bsz) + x.shape[1:])
         by = y[perm].reshape(steps, bsz)
         bm = mask[perm].reshape(steps, bsz)
+        return rng, (bx, by, bm)
+
+    def forward(params, bx, by, bm):
+        """devprof forward phase: the primal pass, returning the vjp
+        residual closure (a jit-able Partial pytree) so the backward
+        phase is measured without recomputing the forward."""
+        return jax.vjp(lambda p: batch_loss(p, bx, by, bm), params)
+
+    def backward(vjp_fn, loss):
+        """devprof backward phase: the cotangent pass alone. ``loss``
+        rides along only to shape/dtype the seed cotangent."""
+        (grads,) = vjp_fn(jnp.ones_like(loss))
+        return grads
+
+    def train_one_epoch(state: TrainState, xym, gate):
+        x, y, mask = xym
+        rng, (bx, by, bm) = prepare_epoch(state, x, y, mask)
+        steps = bx.shape[0]
 
         def step(carry, batch):
             st, loss_sum = carry
             xb, yb, mb = batch
             loss, grads = jax.value_and_grad(batch_loss)(st.params, xb, yb, mb)
-            if explicit_decay:
-                grads = jax.tree.map(
-                    lambda g, p: g + explicit_decay * p, grads, st.params)
-            on = None
-            if gate is not None:
-                # zero grads AND updates instead of where-selecting whole
-                # trees afterward: params stay bit-exact for gated-off
-                # nodes (x + 0 == x) without an extra full-tree memory
-                # pass, and no real gradient leaks into momentum.
-                # ``where``, not ``* gate``: 0.0 * NaN is NaN, and a
-                # gated-off node whose shard produces a non-finite grad
-                # must stay frozen, not poisoned
-                on = gate > 0
-                grads = jax.tree.map(
-                    lambda g: jnp.where(on, g, jnp.zeros_like(g)), grads)
-            fused = (_fused_sgd_step(st, grads, gate, on)
-                     if fuse_sgd else None)
-            if fused is not None:
-                params, opt_state = fused
-            else:
-                updates, opt_state = tx.update(grads, st.opt_state, st.params)
-                if gate is not None:
-                    updates = jax.tree.map(
-                        lambda u: jnp.where(on, u, jnp.zeros_like(u)),
-                        updates)
-                params = optax.apply_updates(st.params, updates)
-            st = st.replace(params=params, opt_state=opt_state,
-                            step=st.step + 1)
+            st = apply_update(st, grads, gate)
             return (st, loss_sum + loss), None
 
         (state, loss_sum), _ = jax.lax.scan(step, (state, 0.0), (bx, by, bm))
@@ -341,7 +382,9 @@ def make_step_fns(
         count = jnp.maximum(count, 1.0)
         return {"loss": loss_sum / count, "accuracy": correct_sum / count}
 
-    return StepFns(init=init, train_epochs=train_epochs, evaluate=evaluate, tx=tx)
+    return StepFns(init=init, train_epochs=train_epochs, evaluate=evaluate,
+                   tx=tx, prepare_epoch=prepare_epoch, forward=forward,
+                   backward=backward, apply_update=apply_update)
 
 
 class NodeLearner:
@@ -426,6 +469,9 @@ class JaxLearner(NodeLearner):
         self.local_step = 0
         self.round = 0
         self._interrupted = False
+        # last fit's devprof_* gauges (MFU/TFLOPs/HBM) for the status
+        # publisher; empty until a fit completes with devprof enabled
+        self.devprof_last: dict = {}
 
     # -- wiring ----------------------------------------------------------
     def set_model(self, model) -> None:
@@ -521,13 +567,30 @@ class JaxLearner(NodeLearner):
                                args={"round": self.round,
                                      "epochs": self.epochs}):
             self._fit_traced()
+        # gauges AFTER the span closes: the once-per-shape FLOP probe
+        # compiles a program, and that compile must not bill itself to
+        # learner.fit (the devprof phase-sum gate checks against it)
+        if devprof.enabled() and getattr(self, "_devprof_wall", 0):
+            self.devprof_last = devprof.fit_gauges(
+                self, self._devprof_wall, self._devprof_epochs)
 
     def _fit_traced(self) -> None:
         x, y, mask = self._fit_args()
         t0 = time.monotonic()
+        self._devprof_wall = 0.0  # stays 0 on an interrupted fit
+        # step-level devprof swaps in the phase-split pipeline (separate
+        # jitted phase programs, each drained inside its span); the
+        # default path runs the fused production program untouched
+        step_prof = (devprof.step_enabled()
+                     and self.fns.prepare_epoch is not None)
+
+        def one_epoch():
+            if step_prof:
+                return devprof.profiled_epoch(self, x, y, mask)
+            return self._train_jit(self.state, x, y, mask, epochs=1)
+
         if self.epochs == 1:
-            self.state, metrics = self._train_jit(self.state, x, y, mask,
-                                                  epochs=1)
+            self.state, metrics = one_epoch()
             epochs_run = 1
         else:
             # multi-epoch fits run one compiled epoch at a time so
@@ -542,11 +605,18 @@ class JaxLearner(NodeLearner):
                 if self._interrupted:
                     self._interrupted = False
                     break
-                self.state, metrics = self._train_jit(self.state, x, y,
-                                                      mask, epochs=1)
+                self.state, metrics = one_epoch()
                 epochs_run += 1
             if metrics is None:
                 return
+        if devprof.enabled():
+            # drain before reading the clock: the fused epoch program
+            # dispatches async, so an un-synced wall would time the
+            # enqueue, not the step, and the MFU gauge would report
+            # dispatch rate (a warm fit "measures" sub-millisecond)
+            jax.block_until_ready(self.state)
+        self._devprof_wall = time.monotonic() - t0
+        self._devprof_epochs = epochs_run
         steps = max(len(self.data.x) // self.batch_size, 1) * epochs_run
         self.local_step = steps
         if self.logger is not None:

@@ -40,6 +40,18 @@ Built-in rules (severity in parentheses; all thresholds live on
   guarantee the run was provisioned for is EXHAUSTED — every further
   round leaks beyond the stated budget, which is an operator-stop
   condition, not a performance smell.
+- ``mfu-collapse`` (warn): a node's live MFU gauge (``devprof_mfu``,
+  obs.devprof) fell below ``mfu_collapse_frac`` of the best it has
+  published this run — compute throughput collapsed while the node
+  still looks alive (input starvation, thermal/SMC throttle, a
+  recompile loop eating the round). Delta-state rule: the engine
+  remembers each node's best-seen MFU, and a run that never exceeded
+  ``mfu_floor`` (CPU smoke runs) can't fire it.
+- ``hbm-watermark`` (warn → crit): device peak-memory high-water
+  (``devprof_hbm_peak_mb``) reached ``hbm_warn_frac`` (warn) /
+  ``hbm_crit_frac`` (crit) of the published HBM limit — the next
+  shape bump or retained buffer OOMs the round. Inert when the
+  backend publishes no limit (CPU hosts).
 - ``partition-suspected`` (crit): the live cohort's per-peer byte
   counters (``peer_bytes_in``/``peer_bytes_out`` in the status
   records) split into 2+ disjoint reachability components — traffic
@@ -106,6 +118,14 @@ class HealthConfig:
     # sidecar-stalled: descriptor-queue depth at/above this while slot
     # releases sit flat across two evaluations reads as a wedged aggd
     sidecar_backlog: int = 4
+    # mfu-collapse: fire when live MFU drops below this fraction of the
+    # node's best-seen; peaks below mfu_floor never arm the rule (CPU
+    # runs report achieved-TFLOPs only, or single-digit-permille MFU)
+    mfu_collapse_frac: float = 0.5
+    mfu_floor: float = 0.02
+    # hbm-watermark: peak bytes vs published device limit
+    hbm_warn_frac: float = 0.85
+    hbm_crit_frac: float = 0.97
 
 
 @dataclasses.dataclass
@@ -367,6 +387,58 @@ def rule_sidecar_stalled(snap: Snapshot, eng: "HealthEngine") -> list[dict]:
     return out
 
 
+def rule_mfu_collapse(snap: Snapshot, eng: "HealthEngine") -> list[dict]:
+    """Live MFU vs the node's own best: utilization is workload- and
+    chip-relative, so an absolute floor would be wrong on every part at
+    once — but HALVING against your own run's best while still alive is
+    a regression wherever it happens. Judged against the engine's
+    previous-evaluation peak (``_note_progress`` folds the current
+    gauge in afterward), so the collapse is measured, not self-reset."""
+    out = []
+    for rec in snap.alive():
+        v = rec.get("devprof_mfu")
+        if v is None:
+            continue
+        node = int(rec.get("node", -1))
+        peak = eng.mfu_peak.get(node, 0.0)
+        if peak < snap.cfg.mfu_floor:
+            continue  # never armed — nothing meaningful to halve from
+        v = float(v)
+        if v < snap.cfg.mfu_collapse_frac * peak:
+            out.append({
+                "node": node,
+                "message": f"MFU collapsed to {100 * v:.1f}% from "
+                           f"best-seen {100 * peak:.1f}% "
+                           f"(< {snap.cfg.mfu_collapse_frac:.0%})",
+            })
+    return out
+
+
+def rule_hbm_watermark(snap: Snapshot, eng: "HealthEngine") -> list[dict]:
+    """Device peak-memory high-water against the backend's published
+    limit. Warn means the headroom is one retained buffer from gone;
+    crit means the next allocation of any size may OOM the round.
+    Inert without a limit gauge — CPU hosts publish RSS only, and a
+    host watermark has no hard ceiling to judge against."""
+    out = []
+    for rec in snap.alive():
+        peak, limit = (rec.get("devprof_hbm_peak_mb"),
+                       rec.get("devprof_hbm_limit_mb"))
+        if peak is None or not limit:
+            continue
+        frac = float(peak) / float(limit)
+        if frac < snap.cfg.hbm_warn_frac:
+            continue
+        sev = "crit" if frac >= snap.cfg.hbm_crit_frac else "warn"
+        out.append({
+            "node": int(rec.get("node", -1)), "severity": sev,
+            "message": f"HBM high-water {float(peak):.0f}MB is "
+                       f"{100 * frac:.0f}% of the "
+                       f"{float(limit):.0f}MB limit",
+        })
+    return out
+
+
 @dataclasses.dataclass(frozen=True)
 class Rule:
     name: str
@@ -385,6 +457,8 @@ def default_rules() -> list[Rule]:
         Rule("epsilon-budget", "warn", rule_epsilon_budget),
         Rule("partition-suspected", "crit", rule_partition_suspected),
         Rule("sidecar-stalled", "warn", rule_sidecar_stalled),
+        Rule("mfu-collapse", "warn", rule_mfu_collapse),
+        Rule("hbm-watermark", "warn", rule_hbm_watermark),
     ]
 
 
@@ -409,6 +483,8 @@ class HealthEngine:
         # node -> (desc-queue depth, slot releases) at the previous
         # evaluation (sidecar-stalled's delta baseline)
         self.aggd_state: dict[int, tuple[int, int]] = {}
+        # node -> best devprof_mfu seen (mfu-collapse's baseline)
+        self.mfu_peak: dict[int, float] = {}
 
     # -- evaluation -----------------------------------------------------
     def _note_progress(self, snap: Snapshot) -> None:
@@ -429,6 +505,12 @@ class HealthEngine:
             if depth is not None and rel is not None:
                 self.aggd_state[int(rec.get("node", -1))] = (
                     int(depth), int(rel))
+        for rec in snap.statuses:
+            v = rec.get("devprof_mfu")
+            if v is not None:
+                node = int(rec.get("node", -1))
+                self.mfu_peak[node] = max(self.mfu_peak.get(node, 0.0),
+                                          float(v))
 
     def evaluate(self, statuses: list[dict[str, Any]],
                  metrics: list[dict[str, Any]] | None = None,

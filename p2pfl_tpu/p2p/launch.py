@@ -247,6 +247,20 @@ def _crossdev_status(obj) -> dict:
     return dict(last)
 
 
+def _devprof_status(obj) -> dict:
+    """Device-profiling gauges (MFU / achieved-TFLOPs / HBM+RSS
+    watermarks) for a status record. Reads ``devprof_last`` off the
+    learner (socket plane: the JaxLearner refreshes it per fit when
+    ``P2PFL_DEVPROF`` is on) — accepts either the learner itself or a
+    Node wrapping one. Empty — rendered "-" — when devprof is off."""
+    last = getattr(obj, "devprof_last", None)
+    if last is None:
+        last = getattr(getattr(obj, "learner", None), "devprof_last", None)
+    if not last:
+        return {}
+    return dict(last)
+
+
 def _free_ports(n: int) -> list[int]:
     socks, ports = [], []
     for _ in range(n):
@@ -375,6 +389,7 @@ async def _run_node(cfg: ScenarioConfig, idx: int, ports: list[int],
                      **_privacy_status(cfg, node.round),
                      **_critpath_status(node),
                      **_crossdev_status(learner),
+                     **_devprof_status(learner),
                      **_aggd_status(sidecar)},
                 )
                 await asyncio.sleep(cfg.protocol.heartbeat_period_s)
@@ -655,6 +670,7 @@ async def _simulate(cfg: ScenarioConfig, timeout: float = 600) -> dict:
                      **_privacy_status(cfg, nd.round),
                      **_critpath_status(nd),
                      **_crossdev_status(nd),
+                     **_devprof_status(nd),
                      **_aggd_status(sidecar)},
                 )
 

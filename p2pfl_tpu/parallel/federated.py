@@ -1065,3 +1065,18 @@ def build_eval_fn(fns: StepFns) -> Callable:
         )
 
     return eval_fn
+
+
+def round_flops(round_jit, fed: FederatedState, *args) -> float | None:
+    """Counted FLOPs of one compiled federated round program.
+
+    Thin adapter over ``obs.cost_model.program_flops`` so the round-fn
+    layer and the live devprof gauge share one cost model with the
+    bench (same cost_analysis read, same caveats — see cost_model's
+    docstring). Lowers at avals: no device work is queued. Callers
+    cache — shapes are fixed for a scenario's lifetime, so the answer
+    never changes mid-run."""
+    from p2pfl_tpu.obs import cost_model
+
+    return cost_model.program_flops(
+        round_jit, *cost_model.avals((fed, *args)))

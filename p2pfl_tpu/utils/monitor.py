@@ -71,9 +71,39 @@ def read_statuses(directory: str | pathlib.Path) -> list[dict[str, Any]]:
     return sorted(out, key=lambda r: r.get("node", 0))
 
 
+# The status-record key registry: every key a publisher (p2p/launch.py,
+# federation/scenario.py) may emit and a renderer (this module,
+# webapp.py) or health rule may read. analysis/statuskeys.py checks the
+# three ways against each other — a renamed gauge that would silently
+# render "-" forever fails tier-1 instead. node/ts/seq come from
+# publish_status itself, not the callers.
+STATUS_KEYS = (
+    # record envelope (make_record + publish_status)
+    "node", "ts", "seq",
+    # federation identity / progress
+    "role", "round", "peers", "leader", "loss", "accuracy", "trust",
+    # round timing + wire traffic
+    "round_p95_s", "bytes_in", "bytes_out",
+    "peer_bytes_in", "peer_bytes_out", "recompiles",
+    # privacy plane
+    "dp_epsilon", "dp_epsilon_budget",
+    # round-18 critical-path components
+    "critpath_round", "critpath_round_s", "critpath_fit_s",
+    "critpath_wire_s", "critpath_wait_s", "critpath_agg_s",
+    "critpath_other_s",
+    # round-20 cross-device throughput
+    "crossdev_clients_per_s", "crossdev_prefetch_mb",
+    "crossdev_prefetch_stall_s",
+    # aggregation sidecar
+    "aggd_desc_q_depth", "aggd_slot_releases", "aggd_bytes_ingested",
+    # round-22 device profiling (obs.devprof)
+    "devprof_fit_s", "devprof_tflops", "devprof_mfu",
+    "devprof_hbm_peak_mb", "devprof_hbm_limit_mb", "devprof_rss_peak_mb",
+)
+
 _COLUMNS = ("node", "role", "round", "loss", "accuracy", "trust",
-            "peers", "p95s", "wait%", "cl/s", "pf", "io_mb", "eps", "age",
-            "health")
+            "peers", "p95s", "wait%", "cl/s", "pf", "mfu", "hbm",
+            "io_mb", "eps", "age", "health")
 
 
 def _health_cell(node: int | None, alerts) -> str:
@@ -117,6 +147,33 @@ def _prefetch_cell(rec: dict[str, Any]) -> str:
     if mb is None and st is None:
         return "-"
     return f"{float(mb or 0):.0f}M/{float(st or 0):.2f}s"
+
+
+def _mfu_cell(rec: dict[str, Any]) -> str:
+    """MFU cell from the devprof gauges: model-FLOP utilization as a
+    percentage when the device has a known peak, achieved TFLOP/s when
+    it does not (CPU dev boxes), "-" with devprof off."""
+    v = rec.get("devprof_mfu")
+    if v is not None:
+        return f"{float(v) * 100:.1f}%"
+    t = rec.get("devprof_tflops")
+    return "-" if t is None else f"{float(t):.2f}T"
+
+
+def _hbm_cell(rec: dict[str, Any]) -> str:
+    """HBM cell: device peak-memory high-water, with percent-of-limit
+    when the backend publishes one; host RSS peak (``r``-prefixed) as
+    the fallback on backends without memory_stats; "-" with devprof
+    off."""
+    peak = rec.get("devprof_hbm_peak_mb")
+    if peak is not None:
+        limit = rec.get("devprof_hbm_limit_mb")
+        cell = f"{float(peak):.0f}M"
+        if limit:
+            cell += f"/{100.0 * float(peak) / float(limit):.0f}%"
+        return cell
+    rss = rec.get("devprof_rss_peak_mb")
+    return "-" if rss is None else f"r{float(rss):.0f}M"
 
 
 def _eps_cell(rec: dict[str, Any]) -> str:
@@ -167,6 +224,10 @@ def _row(rec: dict[str, Any], now: float, liveness_s: float,
         # cohort-scan driver, prefetch MB/stall from streamed rounds.
         "cl/s": _clients_cell(rec),
         "pf": _prefetch_cell(rec),
+        # round-22 device profiling: live utilization + memory
+        # watermarks from the devprof_* gauges (P2PFL_DEVPROF)
+        "mfu": _mfu_cell(rec),
+        "hbm": _hbm_cell(rec),
         "io_mb": (
             "-" if bi is None and bo is None
             else f"{(bi or 0) / 1e6:.1f}/{(bo or 0) / 1e6:.1f}"

@@ -420,6 +420,65 @@ def critpath_pane(statuses: list[dict]) -> str:
     )
 
 
+def devprof_pane(statuses: list[dict]) -> str:
+    """Device-profiling pane (round 22): per-node live utilization —
+    MFU as a filled bar against the chip peak (achieved TFLOP/s shown
+    bare when the backend has no peak table entry, e.g. CPU) and the
+    HBM high-water against its limit (host RSS fallback). Empty string
+    until any node publishes ``devprof_*`` gauges (P2PFL_DEVPROF)."""
+    rows = []
+    for rec in statuses:
+        if rec.get("devprof_fit_s") is None:
+            continue
+        mfu = rec.get("devprof_mfu")
+        tflops = rec.get("devprof_tflops")
+        if mfu is not None:
+            pct = min(100.0 * float(mfu), 100.0)
+            util = (
+                f"<td>{float(mfu) * 100:.1f}%</td><td style='min-width:"
+                "120px'><div style='width:120px;background:#000'>"
+                f"<span style='display:inline-block;background:#3987e5;"
+                f"height:10px;width:{pct:.1f}%'></span></div></td>"
+            )
+        else:
+            util = ("<td>{}</td><td></td>".format(
+                f"{float(tflops):.2f}T" if tflops is not None else "-"))
+        peak = rec.get("devprof_hbm_peak_mb")
+        limit = rec.get("devprof_hbm_limit_mb")
+        rss = rec.get("devprof_rss_peak_mb")
+        if peak is not None and limit:
+            hpct = min(100.0 * float(peak) / float(limit), 100.0)
+            color = "#d95926" if hpct >= 85.0 else "#199e70"
+            mem = (
+                f"<td>{float(peak):.0f}/{float(limit):.0f}M</td>"
+                "<td style='min-width:120px'><div style='width:120px;"
+                f"background:#000'><span style='display:inline-block;"
+                f"background:{color};height:10px;width:{hpct:.1f}%'>"
+                "</span></div></td>"
+            )
+        elif peak is not None:
+            mem = f"<td>{float(peak):.0f}M</td><td></td>"
+        else:
+            mem = ("<td>{}</td><td></td>".format(
+                f"rss {float(rss):.0f}M" if rss is not None else "-"))
+        rows.append(
+            "<tr><td>{n}</td><td>{f:.3f}</td>{util}{mem}</tr>".format(
+                n=rec.get("node", "?"),
+                f=float(rec["devprof_fit_s"]), util=util, mem=mem,
+            )
+        )
+    if not rows:
+        return ""
+    head = "".join(
+        f"<th>{h}</th>"
+        for h in ("NODE", "FIT_S", "MFU", "", "HBM", "")
+    )
+    return (
+        "<h3>device profile (MFU / memory)</h3>"
+        f"<table><tr>{head}</tr>{''.join(rows)}</table>"
+    )
+
+
 class Deployments:
     """Child processes launched through the run endpoint, by scenario
     name (the Controller-in-process role, app.py:679-681 — here a
@@ -1059,7 +1118,7 @@ class DashboardHandler(BaseHTTPRequestHandler):
         alerts, _ = evaluate_dir(safe, engine=HealthEngine())
         inner = render_alerts_html(alerts) + render_table_html(
             statuses, alerts=alerts
-        ) + critpath_pane(statuses)
+        ) + critpath_pane(statuses) + devprof_pane(statuses)
         logs = sorted((safe / "logs").glob("*.log")) if (
             safe / "logs").is_dir() else []
         links = " | ".join(

@@ -41,6 +41,9 @@ import sys
 HEADLINE: dict[str, str] = {
     "value": "lower",  # headline s/round (metric-string matched)
     "mfu": "higher",
+    # round 22: device-slope MFU (pacing sleeps subtracted) — the
+    # utilization number the live devprof gauge is validated against
+    "mfu_device": "higher",
     "round_s_8node": "lower",
     "socket_round_s_24node": "lower",
     "vit32_krum_round_s": "lower",

@@ -39,6 +39,12 @@ import pytest  # noqa: E402
 
 
 def pytest_configure(config):
+    # tier-1 wall audit: always report the 10 slowest tests so a
+    # creeping suite wall names its culprits in every run (an explicit
+    # --durations=N on the command line wins)
+    if not getattr(config.option, "durations", None):
+        config.option.durations = 10
+        config.option.durations_min = 1.0
     config.addinivalue_line(
         "markers",
         "slowtier: minutes-long redundancy-coverage tests, skipped "

@@ -31,7 +31,7 @@ def test_zero_budget_still_emits_parseable_json():
     assert set(out["skipped_phases"]) == {
         "headline", "cifar16", "cpu8", "socket24", "comm", "socket_mp",
         "obs", "obs_health", "robust", "elastic", "cross_device",
-        "chaos", "aggd", "lora", "private", "vit32"
+        "chaos", "aggd", "lora", "private", "devprof", "vit32"
     }
     # the provenance stamp (round 12) rides the envelope even at zero
     # budget — a regression report must always name its commit
@@ -298,6 +298,34 @@ def test_private_phase_dry_run_emits_key_plan():
             "private_eps_nm10", "private_plain_round_s",
             "private_secagg_round_s",
             "private_secagg_overhead_pct"} <= planned
+    assert planned <= set(bench.BENCH_KEYS)
+
+
+def test_devprof_phase_dry_run_emits_key_plan():
+    """P2PFL_DEVPROF_DRY=1: the devprof phase must emit its planned key
+    list as one parseable part without touching jax — the round-22
+    analog of the obs dry-run hook."""
+    env = dict(os.environ, P2PFL_DEVPROF_DRY="1")
+    code = (f"import sys; sys.path.insert(0, {str(REPO)!r})\n"
+            "import bench; bench._phase_devprof()\n")
+    res = subprocess.run([sys.executable, "-c", code],
+                         capture_output=True, text=True, timeout=60,
+                         env=env, cwd=REPO)
+    assert res.returncode == 0, res.stderr[-500:]
+    sys.path.insert(0, str(REPO))
+    import bench
+
+    parts = [json.loads(line[len(bench._PART_TAG):])
+             for line in res.stdout.splitlines()
+             if line.startswith(bench._PART_TAG)]
+    assert len(parts) == 1 and parts[0]["devprof_dry"] is True
+    planned = set(parts[0]["devprof_keys"])
+    assert planned == set(bench._DEVPROF_KEYS)
+    assert {"devprof_overhead_pct", "devprof_phase_sum_err_pct",
+            "devprof_top_component", "devprof_mfu_live",
+            "devprof_mfu_err_pct"} <= planned
+    # every planned key must be registered (and, via
+    # check_bench_keys, documented)
     assert planned <= set(bench.BENCH_KEYS)
 
 

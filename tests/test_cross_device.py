@@ -660,6 +660,7 @@ def test_streamed_round_parity_with_materialized():
     sc_on.close()
 
 
+@pytest.mark.slowtier
 def test_sgd_accum_routed_scan_parity():
     """With the Pallas gate forced on, the fused accumulate routes the
     per-leaf FedAvg partial sum through pallas_gemm.sgd_accum (null
@@ -668,7 +669,14 @@ def test_sgd_accum_routed_scan_parity():
     so this is allclose, not bit-equal — the bit-equal contract is the
     XLA-routed path, pinned above), and the gate must have recorded
     pallas decisions for sgd_accum. Subprocess: the choose() cache is
-    process-wide, so the forced knob needs a fresh interpreter."""
+    process-wide, so the forced knob needs a fresh interpreter.
+
+    slowtier (~4s fresh-interpreter compile): the routed kernel's
+    numerics have fast op-level pins (test_pallas_gemm.py's
+    test_sgd_accum_update_parity / test_sgd_accum_fused_accumulate_
+    parity), and the fused-vs-unfused ROUND parity is pinned bit-equal
+    on the XLA path above; this composition re-proof runs on the
+    P2PFL_SLOW_TESTS=1 tier."""
     import os
 
     code = r"""

@@ -101,7 +101,8 @@ def test_baseline_round_trip(tmp_path):
     res = _run(pos)
     assert res.findings
     bl = tmp_path / "BASELINE.json"
-    core.write_baseline(bl, res.findings)
+    core.write_baseline(bl, res.findings,
+                        justification="fixture positive, kept on purpose")
     entries = core.load_baseline(bl)
     assert len(entries) == len(res.findings)
     # with the baseline loaded, the same findings are grandfathered
@@ -138,7 +139,8 @@ def test_baseline_survives_line_drift(tmp_path):
     f.write_text(body)
     res = core.run_paths([f], ALL_RULES, root=tmp_path)
     bl = tmp_path / "BASELINE.json"
-    core.write_baseline(bl, res.findings)
+    core.write_baseline(bl, res.findings,
+                        justification="drift fixture, kept on purpose")
     f.write_text("# a new header comment\n# another\n" + body)
     res2 = core.run_paths([f], ALL_RULES, root=tmp_path,
                           baseline_entries=core.load_baseline(bl))
@@ -210,12 +212,39 @@ def test_cli_write_baseline(tmp_path, capsys):
                        "--baseline", str(bl), "--write-baseline"])
     capsys.readouterr()
     assert rc == 0
+    # the fresh scaffold is NOT loadable as-is: every entry still
+    # carries the TODO marker a reviewer must replace
+    with pytest.raises(ValueError, match="scaffold"):
+        core.load_baseline(bl)
+    doc = json.loads(bl.read_text())
+    assert doc["entries"]
+    for e in doc["entries"]:
+        assert e["justification"] == core.SCAFFOLD_JUSTIFICATION
+        e["justification"] = "fixture exercises the positive case"
+    bl.write_text(json.dumps(doc))
     entries = core.load_baseline(bl)
     assert entries and all(e["justification"] for e in entries)
     rc = fedlint.main([str(FIXTURES / "async_pos.py"),
                        "--baseline", str(bl)])
     capsys.readouterr()
     assert rc == 0
+
+
+def test_load_baseline_rejects_untouched_scaffold(tmp_path):
+    """Regression: the loader used to accept the --write-baseline
+    default text as a 'non-empty' justification, so a regenerated
+    baseline could merge with zero human words on any entry."""
+    bl = tmp_path / "BL.json"
+    bl.write_text(json.dumps({"version": 1, "entries": [
+        {"rule": "jit-purity", "path": "x.py", "code": "abc",
+         "justification": "  TODO: justify or fix  "}]}))
+    with pytest.raises(ValueError, match="scaffold"):
+        core.load_baseline(bl)
+    # a real justification on the same entry loads fine
+    bl.write_text(json.dumps({"version": 1, "entries": [
+        {"rule": "jit-purity", "path": "x.py", "code": "abc",
+         "justification": "measured: counter is outside the jit"}]}))
+    assert len(core.load_baseline(bl)) == 1
 
 
 # ---------------------------------------------------------------------
@@ -247,12 +276,13 @@ def test_fedlint_cli_over_repo_subprocess():
 
 
 def test_analysis_single_entry_point_runs_all_passes():
-    """``python -m p2pfl_tpu.analysis``: fedlint + bench-keys under
-    one command, combined exit code."""
+    """``python -m p2pfl_tpu.analysis``: fedlint + bench-keys +
+    status-keys under one command, combined exit code."""
     res = subprocess.run(
         [sys.executable, "-m", "p2pfl_tpu.analysis", "p2pfl_tpu/"],
         capture_output=True, text=True, timeout=180, cwd=REPO)
     assert res.returncode == 0, res.stdout + res.stderr
     assert "== fedlint ==" in res.stdout
     assert "== bench-keys ==" in res.stdout
+    assert "== status-keys ==" in res.stdout
     assert "ok:" in res.stdout  # bench-keys kept its text contract

@@ -138,6 +138,68 @@ class TestHealthEngine:
         clears = [tr for tr in eng.transitions if tr["event"] == "clear"]
         assert {c["node"] for c in clears} == {1, 2}
 
+    def test_mfu_collapse_fires_against_own_peak_then_clears(self):
+        """Round 22: live MFU halving against the node's own best-seen
+        fires; recovery clears. The peak folds in AFTER rules run, so
+        the first sighting can never fire against itself."""
+        eng = HealthEngine()
+        t = 1000.0
+        # eval 1 arms the peak (0.4); nothing can fire yet
+        assert eng.evaluate([_status(0, t, devprof_mfu=0.4)], now=t) == []
+        # eval 2: 0.1 < 0.5 * 0.4 -> collapse
+        alerts = eng.evaluate([_status(0, t + 1, devprof_mfu=0.1)],
+                              now=t + 1)
+        assert [(a.rule, a.node, a.severity) for a in alerts] == [
+            ("mfu-collapse", 0, "warn")
+        ]
+        assert "MFU collapsed" in alerts[0].message
+        # recovery clears the alert
+        assert eng.evaluate([_status(0, t + 2, devprof_mfu=0.38)],
+                            now=t + 2) == []
+        events = [(tr["event"], tr["rule"]) for tr in eng.transitions]
+        assert events == [("fire", "mfu-collapse"),
+                          ("clear", "mfu-collapse")]
+
+    def test_mfu_collapse_floor_keeps_cpu_noise_silent(self):
+        """Peaks below mfu_floor never arm the rule: CPU smoke runs
+        report sub-percent MFU whose halving is measurement noise."""
+        eng = HealthEngine()
+        t = 1000.0
+        assert eng.evaluate([_status(0, t, devprof_mfu=0.01)], now=t) == []
+        assert eng.evaluate([_status(0, t + 1, devprof_mfu=0.001)],
+                            now=t + 1) == []
+        # records without the gauge (devprof off) are always inert
+        assert eng.evaluate([_status(0, t + 2, round=3)], now=t + 2) == []
+
+    def test_hbm_watermark_warn_crit_and_inert_without_limit(self):
+        eng = HealthEngine()
+        t = 1000.0
+        recs = [
+            # 90% of limit: warn
+            _status(0, t, devprof_hbm_peak_mb=900.0,
+                    devprof_hbm_limit_mb=1000.0),
+            # 98% of limit: crit
+            _status(1, t, devprof_hbm_peak_mb=980.0,
+                    devprof_hbm_limit_mb=1000.0),
+            # comfortable headroom: silent
+            _status(2, t, devprof_hbm_peak_mb=500.0,
+                    devprof_hbm_limit_mb=1000.0),
+            # RSS-only host (no limit gauge): inert by design
+            _status(3, t, devprof_rss_peak_mb=99999.0),
+        ]
+        alerts = eng.evaluate(recs, now=t)
+        assert [(a.rule, a.node, a.severity) for a in alerts] == [
+            ("hbm-watermark", 1, "crit"),
+            ("hbm-watermark", 0, "warn"),
+        ]
+        assert "HBM high-water" in alerts[0].message
+        # the allocator drains: both clear
+        fresh = [_status(i, t + 1, devprof_hbm_peak_mb=400.0,
+                         devprof_hbm_limit_mb=1000.0) for i in range(2)]
+        assert eng.evaluate(fresh, now=t + 1) == []
+        clears = [tr for tr in eng.transitions if tr["event"] == "clear"]
+        assert {c["node"] for c in clears} == {0, 1}
+
     def test_byte_rate_anomaly_needs_cohort_and_floor(self):
         cfg = HealthConfig(byte_ratio=8.0, byte_floor=1e6, min_cohort=3)
         t = 1000.0
@@ -240,6 +302,32 @@ def test_healthcheck_cli_epsilon_budget_crit_exit_code(tmp_path, capsys):
     assert rc == 2 and doc["severity"] == "crit"
     assert [(a["rule"], a["node"]) for a in doc["alerts"]] \
         == [("epsilon-budget", 1)]
+
+
+def test_healthcheck_cli_hbm_and_mfu_exit_codes(tmp_path, capsys):
+    """Round 22: the devprof gauges drive the watchdog contract — an
+    HBM watermark at crit must exit 2; an MFU collapse (a perf
+    regression, not an outage) exits 1."""
+    status = tmp_path / "status"
+    publish_status(status, 0, {"round": 2, "devprof_hbm_peak_mb": 990.0,
+                               "devprof_hbm_limit_mb": 1000.0})
+    rc = healthcheck_main([str(tmp_path), "--json"])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 2 and doc["severity"] == "crit"
+    assert [(a["rule"], a["node"]) for a in doc["alerts"]] \
+        == [("hbm-watermark", 0)]
+
+    # mfu collapse needs engine state across evals — drive evaluate_dir
+    # with a shared engine the way the healthcheck daemon loop does
+    mfu_dir = tmp_path / "mfu" / "status"
+    publish_status(mfu_dir, 0, {"round": 1, "devprof_mfu": 0.4})
+    alerts, eng = evaluate_dir(mfu_dir.parent, HealthEngine())
+    assert alerts == []
+    publish_status(mfu_dir, 0, {"round": 2, "devprof_mfu": 0.05})
+    alerts, _ = evaluate_dir(mfu_dir.parent, engine=eng)
+    assert [(a.rule, a.severity) for a in alerts] \
+        == [("mfu-collapse", "warn")]
+    assert eng.worst() == "warn"  # the CLI maps warn -> exit 1
 
 
 def test_healthcheck_cli_dead_node_exit_codes(tmp_path, capsys):

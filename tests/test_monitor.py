@@ -141,6 +141,38 @@ def test_crossdev_throughput_columns_terminal_and_html(tmp_path):
     assert "<td>72</td>" in frag and "<td>0M/0.01s</td>" in frag
 
 
+def test_mfu_hbm_columns_terminal_and_html(tmp_path):
+    """Round 22: the MFU and HBM columns render the devprof gauges —
+    MFU as a percentage (achieved TFLOP/s on peakless CPU boxes), HBM
+    as peak MB with percent-of-limit (``r``-prefixed host RSS where
+    the backend publishes no memory_stats) — and "-" with devprof off."""
+    from p2pfl_tpu.utils.monitor import render_table_html
+
+    publish_status(tmp_path, 0, {"role": "trainer", "round": 4,
+                                 "devprof_mfu": 0.123,
+                                 "devprof_tflops": 24.2,
+                                 "devprof_hbm_peak_mb": 1234.0,
+                                 "devprof_hbm_limit_mb": 1450.0})
+    publish_status(tmp_path, 1, {"role": "trainer", "round": 4,
+                                 "devprof_tflops": 0.42,
+                                 "devprof_rss_peak_mb": 553.0})
+    publish_status(tmp_path, 2, {"role": "trainer", "round": 4})
+    table = render_table(read_statuses(tmp_path))
+    lines = table.splitlines()
+    assert lines[0].split()[11] == "MFU"
+    assert lines[0].split()[12] == "HBM"
+    assert lines[2].split()[11] == "12.3%"  # known peak -> utilization
+    assert lines[2].split()[12] == "1234M/85%"
+    assert lines[3].split()[11] == "0.42T"  # peakless -> raw TFLOP/s
+    assert lines[3].split()[12] == "r553M"  # RSS fallback
+    assert lines[4].split()[11] == "-"  # devprof off
+    assert lines[4].split()[12] == "-"
+    frag = render_table_html(read_statuses(tmp_path))
+    assert "<th>MFU</th>" in frag and "<th>HBM</th>" in frag
+    assert "<td>12.3%</td>" in frag and "<td>1234M/85%</td>" in frag
+    assert "<td>0.42T</td>" in frag and "<td>r553M</td>" in frag
+
+
 def test_eps_column_renders_dp_spend(tmp_path):
     """Round 21: the EPS column renders the DP accountant's running
     spend — ``eps/budget`` with a budget, bare ``eps`` without, "-" on
@@ -155,10 +187,10 @@ def test_eps_column_renders_dp_spend(tmp_path):
     publish_status(tmp_path, 2, {"role": "trainer", "round": 3})
     table = render_table(read_statuses(tmp_path))
     lines = table.splitlines()
-    assert lines[0].split()[12] == "EPS"
-    assert lines[2].split()[12] == "4.50/10.00"
-    assert lines[3].split()[12] == "4.50"
-    assert lines[4].split()[12] == "-"  # non-DP run: no eps
+    assert lines[0].split()[14] == "EPS"
+    assert lines[2].split()[14] == "4.50/10.00"
+    assert lines[3].split()[14] == "4.50"
+    assert lines[4].split()[14] == "-"  # non-DP run: no eps
     frag = render_table_html(read_statuses(tmp_path))
     assert "<th>EPS</th>" in frag and "<td>4.50/10.00</td>" in frag
 

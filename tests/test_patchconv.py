@@ -63,6 +63,7 @@ def test_patchconv_gradients_match():
         assert jnp.max(jnp.abs(a - b)) < 1e-4
 
 
+@pytest.mark.slowtier
 def test_pre_patchconv_checkpoint_restores_into_patchconv_model(
         tmp_path, monkeypatch):
     """VERDICT r4 #8: the checkpoint-compat claim, proven with a real
@@ -70,7 +71,15 @@ def test_pre_patchconv_checkpoint_restores_into_patchconv_model(
     convs as nn.Conv — recreated by disabling the patch gate) is
     trained a step, checkpointed through federation/checkpoint.py, and
     restored into the CURRENT PatchConv model. The restored federation
-    must evaluate identically — not just share a param tree."""
+    must evaluate identically — not just share a param tree.
+
+    slowtier (~8s of compiles, the file's other three tests are <1s
+    combined): every invariant it composes has a fast in-suite pin —
+    the identical param tree (test_femnist_cnn_param_tree_unchanged_
+    by_patchconv), forward/grad equivalence (test_patchconv_matches_
+    nnconv, test_patchconv_gradients_match), and checkpoint round-
+    tripping itself (test_checkpoint.py). This end-to-end composition
+    re-proof runs on the P2PFL_SLOW_TESTS=1 tier."""
     import numpy as np
 
     from p2pfl_tpu.federation.checkpoint import (
